@@ -1,0 +1,46 @@
+"""tpu-bitcoinconsensus: TPU-native Bitcoin consensus verification.
+
+A brand-new framework with the capabilities of `rust-bitcoinconsensus`
+(Bitcoin Core 0.21's libbitcoinconsensus): byte-exact script verification
+across bare/P2SH/segwit-v0/Taproot spends, with every ECDSA/Schnorr
+signature check batchable onto TPU via a JAX/Pallas secp256k1 backend.
+
+Layout (see SURVEY.md for the reference layer map this covers):
+- ``core``     — host consensus engine: tx codec, interpreter, sighash
+- ``crypto``   — secp256k1: pure-Python host oracle + batched JAX backend
+- ``ops``      — Pallas/XLA kernels (limb arithmetic, SHA-256)
+- ``models``   — verification pipelines (single verify, deferred batch,
+                 block replay)
+- ``parallel`` — mesh sharding of batches over devices
+- ``utils``    — hashing, helpers
+"""
+
+from .api import (
+    ConsensusError,
+    Error,
+    VERIFY_ALL_EXTENDED,
+    VERIFY_ALL_LIBCONSENSUS,
+    height_to_flags,
+    verify,
+    verify_with_flags,
+    verify_with_spent_outputs,
+    version,
+)
+from .core import flags
+from .core.script_error import ScriptError
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "ConsensusError",
+    "Error",
+    "ScriptError",
+    "VERIFY_ALL_EXTENDED",
+    "VERIFY_ALL_LIBCONSENSUS",
+    "flags",
+    "height_to_flags",
+    "verify",
+    "verify_with_flags",
+    "verify_with_spent_outputs",
+    "version",
+]

@@ -1,0 +1,181 @@
+"""Public API — mirrors the reference crate's surface, plus batch/taproot.
+
+Reference parity (`src/lib.rs:103-139`, `script/bitcoinconsensus.cpp:74-129`):
+``verify``, ``verify_with_flags``, ``height_to_flags``, ``version``, the
+transport-level error enum, the libconsensus flag subset restriction and the
+exact check order of the C ABI shim (flags → deserialize → index → size).
+
+Extensions beyond the reference (SURVEY.md §3.2, §5):
+- ``verify_with_spent_outputs``: supplies all spent outputs, unlocking the
+  BIP341 taproot path the reference's C ABI cannot reach.
+- per-input `ScriptError` detail on failures (the reference swallows it).
+- ``verify_batch`` lives in `bitcoinconsensus_tpu.models.batch` (TPU path).
+"""
+
+from __future__ import annotations
+
+import enum
+from typing import List, Optional, Sequence, Tuple
+
+from .core.flags import (
+    ALL_FLAG_BITS,
+    LIBCONSENSUS_FLAGS,
+    VERIFY_ALL_EXTENDED,
+    VERIFY_ALL_LIBCONSENSUS,
+    VERIFY_TAPROOT,
+    VERIFY_WITNESS,
+    height_to_flags,
+)
+from .core.interpreter import TransactionSignatureChecker, verify_script
+from .core.script_error import ScriptError
+from .core.serialize import SerializationError
+from .core.sighash import PrecomputedTxData
+from .core.tx import Tx, TxOut
+
+__all__ = [
+    "Error",
+    "ConsensusError",
+    "verify",
+    "verify_with_flags",
+    "verify_with_spent_outputs",
+    "version",
+    "height_to_flags",
+    "VERIFY_ALL_LIBCONSENSUS",
+    "VERIFY_ALL_EXTENDED",
+]
+
+API_VERSION = 1  # bitcoinconsensus.h:36 BITCOINCONSENSUS_API_VER
+
+
+class Error(enum.IntEnum):
+    """Transport-level errors (bitcoinconsensus.h:38-46 + lib.rs:172-185)."""
+
+    ERR_OK = 0
+    ERR_TX_INDEX = 1
+    ERR_TX_SIZE_MISMATCH = 2
+    ERR_TX_DESERIALIZE = 3
+    ERR_AMOUNT_REQUIRED = 4
+    ERR_INVALID_FLAGS = 5
+    # Script-level failure (the Rust crate's ERR_SCRIPT, lib.rs:121).
+    ERR_SCRIPT = 6
+
+
+class ConsensusError(Exception):
+    """Raised by verify* on failure; carries the transport error and (as an
+    improvement over the reference, which swallows it) the ScriptError."""
+
+    def __init__(self, code: Error, script_error: Optional[ScriptError] = None):
+        self.code = code
+        self.script_error = script_error
+        detail = f", script_error={script_error.name}" if script_error is not None else ""
+        super().__init__(f"{code.name}{detail}")
+
+
+def version() -> int:
+    """bitcoinconsensus_version (bitcoinconsensus.cpp:125-129)."""
+    return API_VERSION
+
+
+def _verify_input(
+    spent_output_script: bytes,
+    amount: int,
+    spending_transaction: bytes,
+    input_index: int,
+    flags: int,
+    allowed_flags: int,
+    spent_outputs: Optional[Sequence[TxOut]] = None,
+) -> None:
+    """Shared body of the verify entry points; mirrors
+    bitcoinconsensus.cpp:79-101 verify_script check order."""
+    if flags & ~allowed_flags:
+        raise ConsensusError(Error.ERR_INVALID_FLAGS)
+    try:
+        tx = Tx.deserialize(spending_transaction)
+        if input_index >= len(tx.vin):
+            raise ConsensusError(Error.ERR_TX_INDEX)
+        if len(tx.serialize()) != len(spending_transaction):
+            raise ConsensusError(Error.ERR_TX_SIZE_MISMATCH)
+    except SerializationError:
+        raise ConsensusError(Error.ERR_TX_DESERIALIZE) from None
+
+    if spent_outputs is not None:
+        if len(spent_outputs) != len(tx.vin):
+            raise ConsensusError(Error.ERR_TX_INDEX)
+        txdata = PrecomputedTxData(tx, list(spent_outputs))
+    else:
+        if flags & VERIFY_TAPROOT:
+            # BIP341 sighash needs all spent outputs (interpreter.cpp:1512);
+            # reject instead of asserting.
+            raise ConsensusError(Error.ERR_AMOUNT_REQUIRED)
+        txdata = PrecomputedTxData(tx)
+
+    checker = TransactionSignatureChecker(tx, input_index, amount, txdata)
+    ok, script_err = verify_script(
+        tx.vin[input_index].script_sig,
+        spent_output_script,
+        tx.vin[input_index].witness,
+        flags,
+        checker,
+    )
+    if not ok:
+        raise ConsensusError(Error.ERR_SCRIPT, script_err)
+
+
+def verify(
+    spent_output: bytes,
+    amount: int,
+    spending_transaction: bytes,
+    input_index: int,
+) -> None:
+    """verify() (src/lib.rs:103-111): VERIFY_ALL under libconsensus flags.
+
+    Raises ConsensusError on failure; returns None on success.
+    """
+    verify_with_flags(
+        spent_output, amount, spending_transaction, input_index, VERIFY_ALL_LIBCONSENSUS
+    )
+
+
+def verify_with_flags(
+    spent_output_script: bytes,
+    amount: int,
+    spending_transaction: bytes,
+    input_index: int,
+    flags: int,
+) -> None:
+    """verify_with_flags (src/lib.rs:113-139): same flag restriction as the
+    reference C ABI (only libconsensus bits accepted)."""
+    _verify_input(
+        spent_output_script,
+        amount,
+        spending_transaction,
+        input_index,
+        flags,
+        allowed_flags=LIBCONSENSUS_FLAGS,
+    )
+
+
+def verify_with_spent_outputs(
+    spending_transaction: bytes,
+    input_index: int,
+    spent_outputs: Sequence[Tuple[int, bytes]],
+    flags: int = VERIFY_ALL_EXTENDED,
+) -> None:
+    """Extended entry point: all spent outputs supplied → taproot reachable.
+
+    ``spent_outputs`` is a sequence of (amount, scriptPubKey), one per input
+    of the spending transaction (the shape Core's later
+    verify_script_with_spent_outputs ABI adopted).
+    """
+    outs = [TxOut(amt, spk) for amt, spk in spent_outputs]
+    if input_index >= len(outs):
+        raise ConsensusError(Error.ERR_TX_INDEX)
+    _verify_input(
+        outs[input_index].script_pubkey,
+        outs[input_index].value,
+        spending_transaction,
+        input_index,
+        flags,
+        allowed_flags=ALL_FLAG_BITS,
+        spent_outputs=outs,
+    )

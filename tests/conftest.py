@@ -1,0 +1,28 @@
+"""Test config: force an 8-device virtual CPU mesh before JAX imports.
+
+Tests validate multi-chip sharding logic without TPU hardware (the driver
+separately dry-runs the multichip path via __graft_entry__.dryrun_multichip).
+"""
+
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import pytest  # noqa: E402
+
+REFERENCE_ROOT = os.environ.get("BITCOIN_REFERENCE_ROOT", "/root/reference")
+TEST_DATA_DIR = os.path.join(REFERENCE_ROOT, "depend", "bitcoin", "src", "test", "data")
+
+
+def require_test_data():
+    if not os.path.isdir(TEST_DATA_DIR):
+        pytest.skip(f"consensus test vectors not found at {TEST_DATA_DIR}")
+    return TEST_DATA_DIR

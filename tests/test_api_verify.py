@@ -1,0 +1,123 @@
+"""End-to-end verify() tests — the reference crate's own vectors
+(src/lib.rs:215-277) replayed through the new engine."""
+
+import pytest
+
+from bitcoinconsensus_tpu import (
+    ConsensusError,
+    Error,
+    VERIFY_ALL_LIBCONSENSUS,
+    height_to_flags,
+    verify,
+    verify_with_flags,
+    version,
+)
+
+P2PKH_SPENT = "76a9144bfbaf6afb76cc5771bc6404810d1cc041a6933988ac"
+P2PKH_SPENDING = (
+    "02000000013f7cebd65c27431a90bba7f796914fe8cc2ddfc3f2cbd6f7e5f2fc854534da"
+    "95000000006b483045022100de1ac3bcdfb0332207c4a91f3832bd2c2915840165f876ab"
+    "47c5f8996b971c3602201c6c053d750fadde599e6f5c4e1963df0f01fc0d97815e8157e3"
+    "d59fe09ca30d012103699b464d1d8bc9e47d4fb1cdaa89a1c5783d68363c4dbc4b524ed3"
+    "d857148617feffffff02836d3c01000000001976a914fc25d6d5c94003bf5b0c7b640a24"
+    "8e2c637fcfb088ac7ada8202000000001976a914fbed3d9b11183209a57999d54d59f67c"
+    "019e756c88ac6acb0700"
+)
+
+P2SH_P2WPKH_SPENT = "a91434c06f8c87e355e123bdc6dda4ffabc64b6989ef87"
+P2SH_P2WPKH_SPENDING = (
+    "01000000000101d9fd94d0ff0026d307c994d0003180a5f248146efb6371d040c5973f5f"
+    "66d9df0400000017160014b31b31a6cb654cfab3c50567bcf124f48a0beaecffffffff01"
+    "2cbd1c000000000017a914233b74bf0823fa58bbbd26dfc3bb4ae7155471678702473044"
+    "02206f60569cac136c114a58aedd80f6fa1c51b49093e7af883e605c212bdafcd8d20220"
+    "0e91a55f408a021ad2631bc29a67bd6915b2d7e9ef0265627eabd7f7234455f601210"
+    "3e7e802f50344303c76d12c089c8724c1b230e3b745693bbe16aad536293d15e300000000"
+)
+
+P2WSH_SPENT = "0020701a8d401c84fb13e6baf169d59684e17abd9fa216c8cc5b9fc63d622ff8c58d"
+P2WSH_SPENDING = (
+    "010000000001011f97548fbbe7a0db7588a66e18d803d0089315aa7d4cc28360b6ec50ef"
+    "36718a0100000000ffffffff02df1776000000000017a9146c002a686959067f4866b8fb"
+    "493ad7970290ab728757d29f0000000000220020701a8d401c84fb13e6baf169d59684e1"
+    "7abd9fa216c8cc5b9fc63d622ff8c58d04004730440220565d170eed95ff95027a69b313"
+    "758450ba84a01224e1f7f130dda46e94d13f8602207bdd20e307f062594022f12ed5017b"
+    "bf4a055a06aea91c10110a0e3bb23117fc014730440220647d2dc5b15f60bc37dc42618a"
+    "370b2a1490293f9e5c8464f53ec4fe1dfe067302203598773895b4b16d37485cbe21b337"
+    "f4e4b650739880098c592553add7dd4355016952210375e00eb72e29da82b89367947f29"
+    "ef34afb75e8654f6ea368e0acdfd92976b7c2103a1b26313f430c4b15bb1fdce66320765"
+    "9d8cac749a0e53d70eff01874496feff2103c96d495bfdd5ba4145e3e046fee45e84a8a4"
+    "8ad05bd8dbb395c011a32cf9f88053ae00000000"
+)
+
+
+def test_p2pkh_valid():
+    verify(bytes.fromhex(P2PKH_SPENT), 0, bytes.fromhex(P2PKH_SPENDING), 0)
+
+
+def test_p2sh_p2wpkh_valid():
+    verify(
+        bytes.fromhex(P2SH_P2WPKH_SPENT), 1900000, bytes.fromhex(P2SH_P2WPKH_SPENDING), 0
+    )
+
+
+def test_p2wsh_multisig_valid():
+    verify(bytes.fromhex(P2WSH_SPENT), 18393430, bytes.fromhex(P2WSH_SPENDING), 0)
+
+
+def test_p2pkh_wrong_script_fails():
+    # lib.rs:246-250: corrupted pubkey-hash script (last byte ff).
+    bad = P2PKH_SPENT[:-2] + "ff"
+    with pytest.raises(ConsensusError) as ei:
+        verify(bytes.fromhex(bad), 0, bytes.fromhex(P2PKH_SPENDING), 0)
+    assert ei.value.code == Error.ERR_SCRIPT
+
+
+def test_segwit_wrong_amount_fails():
+    with pytest.raises(ConsensusError) as ei:
+        verify(
+            bytes.fromhex(P2SH_P2WPKH_SPENT), 900000, bytes.fromhex(P2SH_P2WPKH_SPENDING), 0
+        )
+    assert ei.value.code == Error.ERR_SCRIPT
+
+
+def test_segwit_wrong_program_fails():
+    bad = P2WSH_SPENT[:-2] + "8f"
+    with pytest.raises(ConsensusError) as ei:
+        verify(bytes.fromhex(bad), 18393430, bytes.fromhex(P2WSH_SPENDING), 0)
+    assert ei.value.code == Error.ERR_SCRIPT
+
+
+def test_invalid_flags():
+    with pytest.raises(ConsensusError) as ei:
+        verify_with_flags(b"", 0, b"", 0, VERIFY_ALL_LIBCONSENSUS + 1)
+    assert ei.value.code == Error.ERR_INVALID_FLAGS
+
+
+def test_deserialize_error():
+    with pytest.raises(ConsensusError) as ei:
+        verify_with_flags(b"", 0, b"\x01\x02", 0, 0)
+    assert ei.value.code == Error.ERR_TX_DESERIALIZE
+
+
+def test_input_index_out_of_range():
+    with pytest.raises(ConsensusError) as ei:
+        verify(bytes.fromhex(P2PKH_SPENT), 0, bytes.fromhex(P2PKH_SPENDING), 5)
+    assert ei.value.code == Error.ERR_TX_INDEX
+
+
+def test_size_mismatch():
+    with pytest.raises(ConsensusError) as ei:
+        verify(bytes.fromhex(P2PKH_SPENT), 0, bytes.fromhex(P2PKH_SPENDING) + b"\x00", 0)
+    assert ei.value.code in (Error.ERR_TX_SIZE_MISMATCH, Error.ERR_TX_DESERIALIZE)
+
+
+def test_version():
+    assert version() == 1
+
+
+def test_height_to_flags():
+    # src/lib.rs:45-65 schedule.
+    assert height_to_flags(0) == 0
+    assert height_to_flags(173805) != 0
+    all_flags = height_to_flags(481824)
+    assert all_flags == VERIFY_ALL_LIBCONSENSUS

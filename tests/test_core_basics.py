@@ -1,0 +1,185 @@
+"""Codec, CScriptNum and hashing unit tests (SURVEY.md §7 build-order gate 1)."""
+
+import pytest
+
+from bitcoinconsensus_tpu.core.script import (
+    ScriptNumError,
+    find_and_delete,
+    is_p2sh,
+    is_witness_program,
+    push_data,
+    script_num_decode,
+    script_num_encode,
+)
+from bitcoinconsensus_tpu.core.serialize import ByteReader, SerializationError, write_compact_size
+from bitcoinconsensus_tpu.core.tx import Tx
+from bitcoinconsensus_tpu.utils.hashes import (
+    _ripemd160_pure,
+    hash160,
+    ripemd160,
+    sha256,
+    sha256d,
+    tagged_hash,
+)
+
+# The reference crate's own end-to-end vector (src/lib.rs:225-229): tx
+# aca326a7... spending the first output of 95da3445...
+P2PKH_SPENDING_HEX = (
+    "02000000013f7cebd65c27431a90bba7f796914fe8cc2ddfc3f2cbd6f7e5f2fc854534da"
+    "95000000006b483045022100de1ac3bcdfb0332207c4a91f3832bd2c2915840165f876ab"
+    "47c5f8996b971c3602201c6c053d750fadde599e6f5c4e1963df0f01fc0d97815e8157e3"
+    "d59fe09ca30d012103699b464d1d8bc9e47d4fb1cdaa89a1c5783d68363c4dbc4b524ed3"
+    "d857148617feffffff02836d3c01000000001976a914fc25d6d5c94003bf5b0c7b640a24"
+    "8e2c637fcfb088ac7ada8202000000001976a914fbed3d9b11183209a57999d54d59f67c"
+    "019e756c88ac6acb0700"
+)
+
+# Segwit P2WSH tx from src/lib.rs:239-243.
+P2WSH_SPENDING_HEX = (
+    "010000000001011f97548fbbe7a0db7588a66e18d803d0089315aa7d4cc28360b6ec50ef"
+    "36718a0100000000ffffffff02df1776000000000017a9146c002a686959067f4866b8fb"
+    "493ad7970290ab728757d29f0000000000220020701a8d401c84fb13e6baf169d5968"
+    "4e17abd9fa216c8cc5b9fc63d622ff8c58d04004730440220565d170eed95ff95027a69"
+    "b313758450ba84a01224e1f7f130dda46e94d13f8602207bdd20e307f062594022f12ed5"
+    "017bbf4a055a06aea91c10110a0e3bb23117fc014730440220647d2dc5b15f60bc37dc42"
+    "618a370b2a1490293f9e5c8464f53ec4fe1dfe067302203598773895b4b16d37485cbe21"
+    "b337f4e4b650739880098c592553add7dd4355016952210375e00eb72e29da82b8936794"
+    "7f29ef34afb75e8654f6ea368e0acdfd92976b7c2103a1b26313f430c4b15bb1fdce6632"
+    "07659d8cac749a0e53d70eff01874496feff2103c96d495bfdd5ba4145e3e046fee45e84"
+    "a8a48ad05bd8dbb395c011a32cf9f88053ae00000000"
+)
+
+
+class TestCompactSize:
+    def test_roundtrip(self):
+        for n in [0, 1, 252, 253, 0xFFFF, 0x10000, 0x1FFFFFF]:
+            enc = write_compact_size(n)
+            assert ByteReader(enc).read_compact_size() == n
+
+    def test_non_canonical_rejected(self):
+        with pytest.raises(SerializationError):
+            ByteReader(b"\xfd\x10\x00").read_compact_size()  # 16 as 3 bytes
+        with pytest.raises(SerializationError):
+            ByteReader(b"\xfe\x00\x01\x00\x00").read_compact_size()
+
+    def test_max_size(self):
+        with pytest.raises(SerializationError):
+            ByteReader(b"\xfe\x01\x00\x00\x02").read_compact_size()
+
+
+class TestTxCodec:
+    def test_p2pkh_roundtrip_and_txid(self):
+        raw = bytes.fromhex(P2PKH_SPENDING_HEX)
+        tx = Tx.deserialize(raw)
+        assert tx.serialize() == raw
+        assert tx.txid_hex == "aca326a724eda9a461c10a876534ecd5ae7b27f10f26c3862fb996f80ea2d45d"
+        assert len(tx.vin) == 1 and len(tx.vout) == 2
+        assert not tx.has_witness()
+        assert tx.vout[0].value == 20737411
+
+    def test_segwit_roundtrip_and_wtxid(self):
+        raw = bytes.fromhex(P2WSH_SPENDING_HEX)
+        tx = Tx.deserialize(raw)
+        assert tx.serialize() == raw
+        assert tx.has_witness()
+        # txid strips witness; wtxid does not.
+        assert tx.txid != tx.wtxid
+        assert len(tx.serialize(include_witness=False)) < len(raw)
+        tx2 = Tx.deserialize(tx.serialize(include_witness=False))
+        assert tx2.txid == tx.txid
+
+    def test_superfluous_witness_rejected(self):
+        raw = bytes.fromhex(P2PKH_SPENDING_HEX)
+        tx = Tx.deserialize(raw)
+        # Rebuild with the witness marker but all-empty witness stacks.
+        body = tx.serialize(include_witness=False)
+        # version | marker 00 | flag 01 | rest | witness stacks | locktime
+        import struct
+        spliced = (
+            body[:4] + b"\x00\x01" + body[4:-4] + b"\x00" * len(tx.vin) + body[-4:]
+        )
+        with pytest.raises(SerializationError, match="Superfluous"):
+            Tx.deserialize(spliced)
+
+
+class TestScriptNum:
+    def test_encode_decode_roundtrip(self):
+        for v in [0, 1, -1, 127, 128, -128, 255, 256, 0x7FFFFFFF, -0x7FFFFFFF]:
+            enc = script_num_encode(v)
+            assert script_num_decode(enc, True) == v
+
+    def test_known_encodings(self):
+        assert script_num_encode(0) == b""
+        assert script_num_encode(1) == b"\x01"
+        assert script_num_encode(-1) == b"\x81"
+        assert script_num_encode(127) == b"\x7f"
+        assert script_num_encode(128) == b"\x80\x00"
+        assert script_num_encode(-128) == b"\x80\x80"
+        assert script_num_encode(255) == b"\xff\x00"
+
+    def test_non_minimal_rejected(self):
+        with pytest.raises(ScriptNumError):
+            script_num_decode(b"\x01\x00", True)
+        with pytest.raises(ScriptNumError):
+            script_num_decode(b"\x80", True)  # negative zero
+        # ...but 0x80 0x80 (=-128) is minimal.
+        assert script_num_decode(b"\x80\x80", True) == -128
+
+    def test_overflow(self):
+        with pytest.raises(ScriptNumError):
+            script_num_decode(b"\x00" * 5, True, 4)
+        # 5-byte allowed for CLTV/CSV.
+        assert script_num_decode(b"\x00\x00\x00\x00\x01", False, 5) == 1 << 32
+
+
+class TestScriptPatterns:
+    def test_p2sh(self):
+        spk = bytes.fromhex("a91434c06f8c87e355e123bdc6dda4ffabc64b6989ef87")
+        assert is_p2sh(spk)
+        assert is_witness_program(spk) is None
+
+    def test_witness_program(self):
+        p2wsh = bytes.fromhex(
+            "0020701a8d401c84fb13e6baf169d59684e17abd9fa216c8cc5b9fc63d622ff8c58d"
+        )
+        wp = is_witness_program(p2wsh)
+        assert wp is not None and wp[0] == 0 and len(wp[1]) == 32
+        p2tr = b"\x51\x20" + b"\x02" * 32
+        wp = is_witness_program(p2tr)
+        assert wp is not None and wp[0] == 1
+
+    def test_push_data_matches_cscript_shift(self):
+        # CScript::operator<< does NOT fold small ints into OP_N.
+        assert push_data(b"\x01") == b"\x01\x01"
+        assert push_data(b"") == b"\x00"
+        assert push_data(b"\x81") == b"\x01\x81"
+        assert push_data(b"a" * 75) == b"\x4b" + b"a" * 75
+        assert push_data(b"a" * 76) == b"\x4c\x4c" + b"a" * 76
+        assert push_data(b"a" * 256)[:3] == b"\x4d\x00\x01"
+
+    def test_find_and_delete(self):
+        # Delete an opcode-aligned push.
+        needle = push_data(b"\xaa\xbb")
+        script = b"\x51" + needle + b"\x52"
+        out, n = find_and_delete(script, needle)
+        assert n == 1 and out == b"\x51\x52"
+        # Non-aligned occurrence is NOT deleted.
+        script2 = push_data(b"\x02\xaa\xbb") + b"\x52"
+        out2, n2 = find_and_delete(script2, needle)
+        assert n2 == 0 and out2 == script2
+
+
+class TestHashes:
+    def test_ripemd160_pure_matches_openssl(self):
+        for data in [b"", b"abc", b"a" * 1000, bytes(range(256))]:
+            assert _ripemd160_pure(data) == ripemd160(data)
+
+    def test_hash160(self):
+        assert hash160(b"") == ripemd160(sha256(b""))
+
+    def test_tagged_hash(self):
+        t = sha256(b"TapLeaf")
+        assert tagged_hash("TapLeaf", b"x") == sha256(t + t + b"x")
+
+    def test_sha256d(self):
+        assert sha256d(b"abc") == sha256(sha256(b"abc"))
