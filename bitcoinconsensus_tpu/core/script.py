@@ -180,11 +180,6 @@ OP_CHECKSIGADD = 0xBA
 
 OP_INVALIDOPCODE = 0xFF
 
-# Sentinel used by the legacy sighash serializer when a code-separator
-# position is "none" (interpreter uses size_t max; we use -1 host-side).
-CODESEPARATOR_NONE = 0xFFFFFFFF
-
-
 class ScriptNumError(Exception):
     """CScriptNum overflow / non-minimal encoding (script.h:227-240 throws)."""
 

@@ -151,17 +151,20 @@ def _serialize_script_code(script_code: bytes) -> bytes:
     seg_start = 0
     pos = 0
     while pos < len(script_code):
-        prev = pos
         opcode, _, pos = decode_op(script_code, pos)
         if opcode is None:
-            # Decoder failed: the reference writes only up to the failure
-            # point (`it`), dropping the trailing partial-push bytes.
+            # Decoder failed on a truncated push. The reference's final write
+            # is `s.write(&itBegin[0], it - itBegin)` (interpreter.cpp:1311)
+            # with `it` left at the decode-failure point by GetScriptOp
+            # (script.cpp advances pc past only the opcode/length bytes) —
+            # the partial-push tail bytes are DROPPED and the declared
+            # CompactSize exceeds the bytes written. Byte-identical here;
+            # pinned by test_sighash_truncated_push_tail.
             out += script_code[seg_start:pos]
             return bytes(out)
         if opcode == OP_CODESEPARATOR:
             out += script_code[seg_start : pos - 1]
             seg_start = pos
-        del prev
     if seg_start != len(script_code):
         out += script_code[seg_start:]
     return bytes(out)

@@ -167,20 +167,20 @@ class Tx:
     def serialize(self, include_witness: bool = True) -> bytes:
         """Exact mirror of SerializeTransaction (transaction.h:227-253)."""
         use_witness = include_witness and self.has_witness()
-        out = struct.pack("<i", self.version)
+        parts = [struct.pack("<i", self.version)]
         if use_witness:
-            out += write_compact_size(0) + b"\x01"
-        out += write_compact_size(len(self.vin))
+            parts.append(write_compact_size(0) + b"\x01")
+        parts.append(write_compact_size(len(self.vin)))
         for txin in self.vin:
-            out += txin.serialize()
-        out += write_compact_size(len(self.vout))
+            parts.append(txin.serialize())
+        parts.append(write_compact_size(len(self.vout)))
         for txout in self.vout:
-            out += txout.serialize()
+            parts.append(txout.serialize())
         if use_witness:
             for txin in self.vin:
-                out += _ser_witness_stack(txin.witness)
-        out += struct.pack("<I", self.locktime)
-        return out
+                parts.append(_ser_witness_stack(txin.witness))
+        parts.append(struct.pack("<I", self.locktime))
+        return b"".join(parts)
 
     # -- identity -----------------------------------------------------------
     @property

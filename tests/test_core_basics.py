@@ -183,3 +183,26 @@ class TestHashes:
 
     def test_sha256d(self):
         assert sha256d(b"abc") == sha256(sha256(b"abc"))
+
+
+class TestSighashScriptCodeSerializer:
+    def test_sighash_truncated_push_tail(self):
+        """Pin the reference's SerializeScriptCode behavior on truncated
+        pushes (interpreter.cpp:1291-1312): the final write spans only to
+        GetOp's failure point, dropping partial-push tail bytes, so the
+        declared CompactSize exceeds the payload written."""
+        from bitcoinconsensus_tpu.core.sighash import _serialize_script_code
+
+        # OP_CODESEPARATOR + truncated PUSHDATA1 announcing 0x50 bytes with
+        # only 10 present: declared 12, payload written = '4c50' (2 bytes).
+        sc = b"\xab\x4c\x50" + bytes(10)
+        assert _serialize_script_code(sc) == b"\x0c\x4c\x50"
+
+        # OP_1, OP_CODESEPARATOR, truncated PUSHDATA1 0x05 with 1 byte:
+        # declared 4, payload '51' + '4c05'.
+        sc2 = b"\x51\xab\x4c\x05\x00"
+        assert _serialize_script_code(sc2) == b"\x04\x51\x4c\x05"
+
+        # Well-formed case: separators removed, size adjusted.
+        sc3 = b"\x51\xab\x52\xab\x53"
+        assert _serialize_script_code(sc3) == b"\x03\x51\x52\x53"
