@@ -1,0 +1,172 @@
+"""Batched secp256k1 group ops and double-scalar multiplication for TPU.
+
+Points are Jacobian triples ``(X, Y, Z)`` of weak field elements (see
+`limbs.py`), batched over leading axes; ``Z ≡ 0`` encodes infinity. All
+control flow is branchless: exceptional cases of the addition law (equal
+points, negated points, infinity operands) are computed alongside the generic
+formula and chosen with masks, so one traced program is consensus-exact for
+*every* lane — the TPU-native replacement for the reference's per-case
+branches in `secp256k1/src/group_impl.h` (gej_double, gej_add_ge_var).
+
+The verify workload is R = a·G + b·P per lane (`secp256k1_ecmult`,
+`secp256k1/src/ecmult_impl.h:561-580`). The reference runs Strauss-wNAF per
+call on one core; here every lane walks the same 256 MSB-first bit steps
+(double, conditionally add G, conditionally add P) under `lax.fori_loop`, so
+thousands of verifications advance in lockstep on the VPU. No secret data is
+involved on the verify path, so uniform (non-constant-time) schedules are
+fine — same stance as the reference's variable-time verify routines.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+from jax import lax
+
+from .limbs import (
+    MASK,
+    NLIMB,
+    RADIX,
+    fe_add,
+    fe_canon,
+    fe_inv,
+    fe_is_zero,
+    fe_mul,
+    fe_mul_small,
+    fe_sqr,
+    fe_sub,
+    int_to_limbs,
+)
+
+__all__ = [
+    "G_X",
+    "G_Y",
+    "jacobian_double",
+    "jacobian_madd_complete",
+    "double_scalar_mult",
+    "jacobian_to_affine",
+    "scalar_bits",
+]
+
+G_X = 0x79BE667EF9DCBBAC55A06295CE870B07029BFCDB2DCE28D959F2815B16F81798
+G_Y = 0x483ADA7726A3C4655DA4FBFC0E1108A8FD17B448A68554199C47D08FFB10D4B8
+
+_GX_LIMBS = int_to_limbs(G_X)
+_GY_LIMBS = int_to_limbs(G_Y)
+_ONE = int_to_limbs(1)
+
+NBITS = NLIMB * RADIX  # 260 bit positions per scalar (top 4 always zero)
+
+
+def jacobian_double(X, Y, Z):
+    """Point doubling, dbl-2009-l for a=0; maps infinity to infinity."""
+    A = fe_sqr(X)
+    B = fe_sqr(Y)
+    C = fe_sqr(B)
+    D = fe_mul_small(fe_sub(fe_sqr(fe_add(X, B)), fe_add(A, C)), 2)
+    E = fe_mul_small(A, 3)
+    F = fe_sqr(E)
+    X3 = fe_sub(F, fe_mul_small(D, 2))
+    Y3 = fe_sub(fe_mul(E, fe_sub(D, X3)), fe_mul_small(C, 8))
+    Z3 = fe_mul_small(fe_mul(Y, Z), 2)  # Z=0 -> Z3=0: infinity is preserved
+    return X3, Y3, Z3
+
+
+def _select(mask, a3, b3):
+    """Per-lane select between two point triples; mask shape (...,)."""
+    m = mask[..., None]
+    return tuple(jnp.where(m, x, y) for x, y in zip(a3, b3))
+
+
+def jacobian_madd_complete(X1, Y1, Z1, x2, y2):
+    """Complete mixed addition (X1,Y1,Z1) + (x2,y2) with (x2,y2) affine,
+    never infinity. Handles all exceptional cases branchlessly:
+
+    - (X1,Y1,Z1) infinite  -> (x2, y2, 1)
+    - equal points         -> doubling result
+    - negated points       -> infinity
+
+    Generic path is madd-2007-bl (the same math as the reference's
+    `secp256k1_gej_add_ge_var`, `group_impl.h`, vectorized and de-branched).
+    """
+    Z1Z1 = fe_sqr(Z1)
+    U2 = fe_mul(x2, Z1Z1)
+    S2 = fe_mul(y2, fe_mul(Z1, Z1Z1))
+    H = fe_sub(U2, X1)
+    Rsub = fe_sub(S2, Y1)
+    h_zero = fe_is_zero(H)
+    r_zero = fe_is_zero(Rsub)
+
+    HH = fe_sqr(H)
+    I = fe_mul_small(HH, 4)
+    J = fe_mul(H, I)
+    r = fe_mul_small(Rsub, 2)
+    V = fe_mul(X1, I)
+    X3 = fe_sub(fe_sqr(r), fe_add(J, fe_mul_small(V, 2)))
+    Y3 = fe_sub(fe_mul(r, fe_sub(V, X3)), fe_mul_small(fe_mul(Y1, J), 2))
+    Z3 = fe_sub(fe_sqr(fe_add(Z1, H)), fe_add(Z1Z1, HH))
+    out = (X3, Y3, Z3)
+
+    dbl = jacobian_double(X1, Y1, Z1)
+    zeros = jnp.zeros_like(X1)
+    ones = jnp.broadcast_to(jnp.asarray(_ONE), X1.shape).astype(X1.dtype)
+    inf = (ones, ones, zeros)
+    lift = (jnp.broadcast_to(x2, X1.shape).astype(X1.dtype),
+            jnp.broadcast_to(y2, X1.shape).astype(X1.dtype), ones)
+
+    out = _select(h_zero & r_zero, dbl, out)
+    out = _select(h_zero & ~r_zero, inf, out)
+    out = _select(fe_is_zero(Z1), lift, out)
+    return out
+
+
+def scalar_bits(limbs):
+    """(..., 20) scalar limbs -> (..., 260) bits, LSB first."""
+    shifts = jnp.arange(RADIX, dtype=jnp.int32)
+    bits = (limbs[..., :, None] >> shifts) & 1
+    return bits.reshape(bits.shape[:-2] + (NBITS,))
+
+
+def double_scalar_mult(a, b, px, py):
+    """R = a·G + b·P per lane (the ECDSA/Schnorr verify hot kernel).
+
+    `a`, `b`: (..., 20) scalar limb vectors (values < 2^256, i.e. bit
+    positions 256..259 zero). `px`, `py`: (..., 20) affine point (never
+    infinity; host substitutes a dummy and masks invalid lanes).
+    Returns a Jacobian triple. 256 iterations of double + 2 conditional
+    complete additions, identical schedule in every lane.
+    """
+    bits_a = scalar_bits(a)
+    bits_b = scalar_bits(b)
+    gx = jnp.broadcast_to(jnp.asarray(_GX_LIMBS), px.shape).astype(px.dtype)
+    gy = jnp.broadcast_to(jnp.asarray(_GY_LIMBS), py.shape).astype(py.dtype)
+    zeros = jnp.zeros_like(px)
+    ones = jnp.broadcast_to(jnp.asarray(_ONE), px.shape).astype(px.dtype)
+    init = (ones, ones, zeros)  # infinity
+
+    def body(i, R):
+        t = 255 - i
+        R = jacobian_double(*R)
+        ba = lax.dynamic_index_in_dim(bits_a, t, axis=-1, keepdims=False)
+        Ra = jacobian_madd_complete(*R, gx, gy)
+        R = _select(ba == 1, Ra, R)
+        bb = lax.dynamic_index_in_dim(bits_b, t, axis=-1, keepdims=False)
+        Rb = jacobian_madd_complete(*R, px, py)
+        R = _select(bb == 1, Rb, R)
+        return R
+
+    return lax.fori_loop(0, 256, body, init)
+
+
+def jacobian_to_affine(X, Y, Z):
+    """(X, Y, Z) -> (x, y, is_infinity) with x, y canonical in [0, p).
+
+    Uses one Fermat inversion per lane (~500 muls — <5% of a 256-bit
+    double-and-add). Infinity lanes return x = y = 0 and the mask.
+    """
+    zi = fe_inv(Z)
+    zi2 = fe_sqr(zi)
+    x = fe_canon(fe_mul(X, zi2))
+    y = fe_canon(fe_mul(Y, fe_mul(zi2, zi)))
+    return x, y, fe_is_zero(Z)
