@@ -201,6 +201,14 @@ class BaseSignatureChecker:
     def check_sequence(self, sequence: int) -> bool:
         return False
 
+    def verify_taproot_tweak(
+        self, q: bytes, parity: int, p: bytes, t: bytes
+    ) -> bool:
+        """Taproot commitment curve check (pubkey.cpp:184-189
+        CheckPayToContract). Exposed on the checker as the deferral seam for
+        the batched TPU backend; semantics are pure (no tx context)."""
+        return secp_host.xonly_tweak_add_check(q, parity, p, t)
+
 
 class TransactionSignatureChecker(BaseSignatureChecker):
     """interpreter.cpp:1645-1788 GenericTransactionSignatureChecker."""
@@ -999,11 +1007,16 @@ def execute_witness_script(
 
 
 def verify_taproot_commitment(
-    control: bytes, program: bytes, script: bytes
+    control: bytes,
+    program: bytes,
+    script: bytes,
+    checker: Optional[BaseSignatureChecker] = None,
 ) -> Optional[bytes]:
     """VerifyTaprootCommitment (interpreter.cpp:1834-1853).
 
-    Returns the tapleaf hash on success, None on failure.
+    Returns the tapleaf hash on success, None on failure. The final curve
+    check routes through `checker.verify_taproot_tweak` when a checker is
+    given (deferral seam).
     """
     path_len = (len(control) - TAPROOT_CONTROL_BASE_SIZE) // TAPROOT_CONTROL_NODE_SIZE
     p = control[1:TAPROOT_CONTROL_BASE_SIZE]  # internal key
@@ -1030,7 +1043,11 @@ def verify_taproot_commitment(
     eng = tagged_hash_midstate_engine("TapTweak")
     eng.update(p + k)
     t = eng.digest()
-    if secp_host.xonly_tweak_add_check(q, control[0] & 1, p, t):
+    if checker is None:
+        ok = secp_host.xonly_tweak_add_check(q, control[0] & 1, p, t)
+    else:
+        ok = checker.verify_taproot_tweak(q, control[0] & 1, p, t)
+    if ok:
         return tapleaf_hash
     return None
 
@@ -1112,7 +1129,9 @@ def verify_witness_program(
                 or (len(control) - TAPROOT_CONTROL_BASE_SIZE) % TAPROOT_CONTROL_NODE_SIZE != 0
             ):
                 return False, E.TAPROOT_WRONG_CONTROL_SIZE
-            tapleaf_hash = verify_taproot_commitment(control, program, exec_script)
+            tapleaf_hash = verify_taproot_commitment(
+                control, program, exec_script, checker
+            )
             if tapleaf_hash is None:
                 return False, E.WITNESS_PROGRAM_MISMATCH
             execdata.tapleaf_hash = tapleaf_hash

@@ -1,0 +1,280 @@
+"""Batched TPU signature verification: one kernel for ECDSA, Schnorr, taproot.
+
+The reference verifies one signature per call on one core
+(`secp256k1_ecdsa_verify`, `secp256k1/src/secp256k1.c:423`;
+`secp256k1_schnorrsig_verify`, `modules/schnorrsig/main_impl.h:190`;
+`secp256k1_xonly_pubkey_tweak_add_check`, `modules/extrakeys/main_impl.h:109`).
+All three reduce to the same algebra — compute R = a·G + b·P and compare R
+against a target — so this backend folds a *mixed* batch of all three check
+kinds into ONE device dispatch of the `double_scalar_mult` kernel:
+
+    kind      a        b      P            accept
+    ECDSA     m/s      r/s    pubkey       R.x ≡ r (mod n)      [x==r or x==r+n]
+    Schnorr   s        n-e    lift_x(pk)   R.x == r and even(R.y)
+    tweak     t        1      lift_x(pki)  R.x == out_x and parity(R.y) matches
+
+Host-side prep (byte parsing, lax-DER, batched modular inverse of s, BIP340
+challenge hashes) is branchy and tiny; device-side is the uniform 256-bit
+double-and-add — the split the SURVEY §7 architecture prescribes. Lanes that
+fail host-side structural checks get a dummy point and a False mask; the
+per-lane accept targets use a sentinel (p itself, never produced by a
+canonical field element) to encode "no secondary target".
+
+Batches are padded to the next power of two (>= 8) so jit caches a handful
+of shapes. Results are bit-identical to the host oracle
+(`crypto/secp_host.py`), which is itself differentially tested against the
+consensus vectors.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..utils.hashes import tagged_hash
+from ..ops.limbs import NLIMB, P_INT, int_to_limbs
+
+from ..ops.curve import G_X, G_Y, double_scalar_mult, jacobian_to_affine
+from .secp_host import N, lift_x, parse_der_lax, parse_pubkey
+
+__all__ = ["SigCheck", "TpuSecpVerifier", "default_verifier"]
+
+# Persistent XLA compilation cache: the verify kernel is large (a 256-step
+# double-and-add body); caching makes every process after the first fast.
+_CACHE_DIR = os.environ.get(
+    "BITCOINCONSENSUS_TPU_CACHE", os.path.expanduser("~/.cache/bitcoinconsensus_tpu_xla")
+)
+try:  # pragma: no cover - depends on jax version/platform
+    jax.config.update("jax_compilation_cache_dir", _CACHE_DIR)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+except Exception:
+    pass
+
+
+class SigCheck:
+    """One deferred signature-algebra check (host-parsed, device-verified).
+
+    kind: 'ecdsa'   -> data = (pubkey_bytes, sig_der_no_hashtype, msg32)
+          'schnorr' -> data = (pubkey32, sig64, msg32)
+          'tweak'   -> data = (tweaked32, parity, internal32, tweak32)
+    """
+
+    __slots__ = ("kind", "data")
+
+    def __init__(self, kind: str, data: Tuple):
+        assert kind in ("ecdsa", "schnorr", "tweak")
+        self.kind = kind
+        self.data = data
+
+
+def _batch_inv_mod_n(vals: List[int]) -> List[int]:
+    """Montgomery batch inversion mod the group order n (one modexp total)."""
+    prefix = []
+    acc = 1
+    for v in vals:
+        acc = acc * v % N
+        prefix.append(acc)
+    inv = pow(acc, N - 2, N)
+    out = [0] * len(vals)
+    for i in range(len(vals) - 1, -1, -1):
+        out[i] = inv * (prefix[i - 1] if i else 1) % N
+        inv = inv * vals[i] % N
+    return out
+
+
+_SENTINEL = P_INT  # never equals a canonical field element (< p)
+
+
+class _Lane:
+    __slots__ = ("valid", "a", "b", "px", "py", "t1", "t2", "parity")
+
+    def __init__(self):
+        # Invalid-lane defaults: 0·G + 0·G, impossible targets.
+        self.valid = False
+        self.a = 0
+        self.b = 0
+        self.px = G_X
+        self.py = G_Y
+        self.t1 = _SENTINEL
+        self.t2 = _SENTINEL
+        self.parity = -1  # -1: don't care
+
+
+def _prep_ecdsa(lane: _Lane, pubkey: bytes, sig_der: bytes, msg32: bytes):
+    """Mirror of CPubKey::Verify host half (pubkey.cpp:191-207): parse
+    pubkey, lax-DER parse, normalize S; u1/u2 are filled in later after the
+    batched inversion. Returns s for the inversion batch, or None."""
+    pt = parse_pubkey(pubkey)
+    if pt is None:
+        return None
+    rs = parse_der_lax(sig_der)
+    if rs is None:
+        return None
+    r, s = rs
+    if s > N // 2:
+        s = N - s  # normalize high-S (pubkey.cpp:204)
+    if r == 0 or s == 0:
+        return None
+    lane.px, lane.py = pt
+    lane.t1 = r
+    lane.t2 = r + N if r + N < P_INT else _SENTINEL
+    lane.valid = True
+    return r, s, int.from_bytes(msg32, "big") % N
+
+
+def _prep_schnorr(lane: _Lane, pubkey32: bytes, sig64: bytes, msg32: bytes):
+    """BIP340 verify host half (modules/schnorrsig/main_impl.h:190-237)."""
+    if len(pubkey32) != 32 or len(sig64) != 64:
+        return
+    pt = lift_x(int.from_bytes(pubkey32, "big"))
+    if pt is None:
+        return
+    r = int.from_bytes(sig64[:32], "big")
+    s = int.from_bytes(sig64[32:], "big")
+    if r >= P_INT or s >= N:
+        return
+    e = int.from_bytes(
+        tagged_hash("BIP0340/challenge", sig64[:32] + pubkey32 + msg32), "big"
+    ) % N
+    lane.px, lane.py = pt
+    lane.a = s
+    lane.b = (N - e) % N  # (n-e)·P = -e·P
+    lane.t1 = r
+    lane.parity = 0  # require even y
+    lane.valid = True
+
+
+def _prep_tweak(lane: _Lane, tweaked32: bytes, parity: int, internal32: bytes,
+                tweak32: bytes):
+    """Taproot commitment check host half (extrakeys/main_impl.h:109-129):
+    Q = P_internal + t·G must equal (tweaked_x, parity)."""
+    pt = lift_x(int.from_bytes(internal32, "big"))
+    if pt is None:
+        return
+    t = int.from_bytes(tweak32, "big")
+    if t >= N:
+        return
+    tx = int.from_bytes(tweaked32, "big")
+    lane.px, lane.py = pt
+    lane.a = t
+    lane.b = 1
+    lane.t1 = tx if tx < P_INT else _SENTINEL
+    lane.parity = parity & 1
+    lane.valid = True
+
+
+def _verify_kernel(a, b, px, py, t1, t2, parity_req, valid):
+    """Device side: R = a·G + b·P; accept per lane against targets."""
+    X, Y, Z = double_scalar_mult(a, b, px, py)
+    x, y, inf = jacobian_to_affine(X, Y, Z)
+    ok_x = jnp.all(x == t1, axis=-1) | jnp.all(x == t2, axis=-1)
+    y_odd = (y[..., 0] & 1) == 1
+    par_ok = (parity_req < 0) | (y_odd == (parity_req == 1))
+    return valid & ~inf & ok_x & par_ok
+
+
+class TpuSecpVerifier:
+    """Batched verifier; pads to power-of-two batch shapes and jits once per
+    shape (persistent XLA cache across processes)."""
+
+    def __init__(self, min_batch: int = 8, max_batch: int = 1 << 16):
+        self._kernel = jax.jit(_verify_kernel)
+        self._min_batch = min_batch
+        self._max_batch = max_batch
+
+    def _pad(self, n: int) -> int:
+        size = self._min_batch
+        while size < n:
+            size *= 2
+        return size
+
+    def verify_checks(self, checks: Sequence[SigCheck]) -> np.ndarray:
+        """Verify a mixed batch; returns bool array aligned with `checks`."""
+        if not checks:
+            return np.zeros(0, dtype=bool)
+        lanes = [_Lane() for _ in checks]
+        ecdsa_pending = []  # (lane, r, s, m)
+        for lane, chk in zip(lanes, checks):
+            if chk.kind == "ecdsa":
+                got = _prep_ecdsa(lane, *chk.data)
+                if got is not None:
+                    ecdsa_pending.append((lane, *got))
+            elif chk.kind == "schnorr":
+                _prep_schnorr(lane, *chk.data)
+            else:
+                _prep_tweak(lane, *chk.data)
+        if ecdsa_pending:
+            sinvs = _batch_inv_mod_n([s for _, _, s, _ in ecdsa_pending])
+            for (lane, r, _s, m), sinv in zip(ecdsa_pending, sinvs):
+                lane.a = m * sinv % N  # u1
+                lane.b = r * sinv % N  # u2
+        out = np.zeros(len(checks), dtype=bool)
+        todo = [i for i, lane in enumerate(lanes) if lane.valid]
+        if not todo:
+            return out
+        # Device dispatch (chunked at max_batch to bound memory).
+        for start in range(0, len(todo), self._max_batch):
+            idx = todo[start : start + self._max_batch]
+            out[idx] = self._dispatch([lanes[i] for i in idx])
+        return out
+
+    def _dispatch(self, lanes: List[_Lane]) -> np.ndarray:
+        n = len(lanes)
+        size = self._pad(n)
+
+        def fill(get, pad_value):
+            arr = np.zeros((size, NLIMB), dtype=np.int32)
+            for i, lane in enumerate(lanes):
+                arr[i] = int_to_limbs(get(lane))
+            if pad_value is not None:
+                for i in range(n, size):
+                    arr[i] = int_to_limbs(pad_value)
+            return arr
+
+        a = fill(lambda l: l.a, 0)
+        b = fill(lambda l: l.b, 0)
+        px = fill(lambda l: l.px, G_X)
+        py = fill(lambda l: l.py, G_Y)
+        t1 = fill(lambda l: l.t1, _SENTINEL)
+        t2 = fill(lambda l: l.t2, _SENTINEL)
+        parity = np.full(size, -1, dtype=np.int32)
+        valid = np.zeros(size, dtype=bool)
+        for i, lane in enumerate(lanes):
+            parity[i] = lane.parity
+            valid[i] = lane.valid
+        res = self._kernel(a, b, px, py, t1, t2, parity, valid)
+        return np.asarray(res)[:n]
+
+    # Convenience single-check wrappers (used by tests/differential fuzzing).
+    def verify_ecdsa(self, pubkey: bytes, sig_der: bytes, msg32: bytes) -> bool:
+        return bool(self.verify_checks([SigCheck("ecdsa", (pubkey, sig_der, msg32))])[0])
+
+    def verify_schnorr(self, pubkey32: bytes, sig64: bytes, msg32: bytes) -> bool:
+        return bool(
+            self.verify_checks([SigCheck("schnorr", (pubkey32, sig64, msg32))])[0]
+        )
+
+    def tweak_add_check(
+        self, tweaked32: bytes, parity: int, internal32: bytes, tweak32: bytes
+    ) -> bool:
+        return bool(
+            self.verify_checks(
+                [SigCheck("tweak", (tweaked32, parity, internal32, tweak32))]
+            )[0]
+        )
+
+
+_default: Optional[TpuSecpVerifier] = None
+
+
+def default_verifier() -> TpuSecpVerifier:
+    """Process-wide verifier (compiled kernels are shared via jit cache)."""
+    global _default
+    if _default is None:
+        _default = TpuSecpVerifier()
+    return _default

@@ -1,0 +1,236 @@
+"""`verify_batch()` — the TPU-era equivalent of Core's per-input fan-out.
+
+The reference parallelizes block validation by pushing one `CScriptCheck`
+per input onto a thread-pool queue (`checkqueue.h:29-163`,
+`validation.cpp:2190`). The TPU-native design replaces thread-level
+parallelism with *signature-level batching* using the checker-override seam
+the reference itself provides (`DeferringSignatureChecker`,
+`interpreter.h:275-301`; `CachingTransactionSignatureChecker`,
+`script/sigcache.cpp:101-122`):
+
+1. Every input's script runs on host with a `DeferringSignatureChecker`
+   that records each curve operation (ECDSA / Schnorr / taproot-tweak) and
+   optimistically reports success (encoding checks still run inline).
+2. All recorded checks from all inputs — deduplicated, the in-batch
+   analogue of Core's salted sig cache (`script/sigcache.cpp:22-122`) —
+   resolve in one mixed device dispatch (`crypto/jax_backend.py`).
+3. Any input whose optimistic guesses were wrong is re-run synchronously
+   with the exact host checker. This is required because check results feed
+   script control flow (`OP_CHECKSIG` pushes the bool, interpreter.cpp:1097;
+   CHECKMULTISIG's cursor advance, interpreter.cpp:1177-1205; NULLFAIL,
+   interpreter.cpp:365-366). Valid-signature batches — the mainnet common
+   case — never take this path.
+
+Batch results are bit-identical to per-input `verify_with_flags` /
+`verify_with_spent_outputs`, including `Error` codes and `ScriptError`s
+(asserted by tests/test_batch.py).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from ..api import ConsensusError, Error
+from ..core.flags import ALL_FLAG_BITS, LIBCONSENSUS_FLAGS, VERIFY_TAPROOT
+from ..core.interpreter import (
+    ScriptExecutionData,
+    TransactionSignatureChecker,
+    verify_script,
+)
+from ..core.script_error import ScriptError
+from ..core.serialize import SerializationError
+from ..core.sighash import PrecomputedTxData
+from ..core.tx import Tx, TxOut
+from ..crypto.jax_backend import SigCheck, TpuSecpVerifier, default_verifier
+
+__all__ = ["BatchItem", "BatchResult", "verify_batch"]
+
+
+@dataclass
+class BatchItem:
+    """One input verification request.
+
+    `spent_outputs` (all prevouts of the tx, in input order) unlocks the
+    taproot path; with only `spent_output_script`+`amount` the item has the
+    same reach as the reference C ABI (SURVEY §3.2).
+    """
+
+    spending_tx: bytes
+    input_index: int
+    flags: int
+    spent_output_script: Optional[bytes] = None
+    amount: int = 0
+    spent_outputs: Optional[Sequence[Tuple[int, bytes]]] = None
+
+
+@dataclass
+class BatchResult:
+    ok: bool
+    error: Error
+    script_error: Optional[ScriptError] = None
+
+    @staticmethod
+    def success() -> "BatchResult":
+        return BatchResult(True, Error.ERR_OK, ScriptError.OK)
+
+
+class DeferringSignatureChecker(TransactionSignatureChecker):
+    """Records curve checks and optimistically succeeds; the sighash and all
+    encoding checks still run inline (they are host work by design)."""
+
+    def __init__(self, tx, n_in, amount, txdata):
+        super().__init__(tx, n_in, amount, txdata)
+        self.recorded: List[SigCheck] = []
+
+    def verify_ecdsa(self, sig_der: bytes, pubkey: bytes, sighash: bytes) -> bool:
+        self.recorded.append(SigCheck("ecdsa", (pubkey, sig_der, sighash)))
+        return True
+
+    def verify_schnorr(self, sig64: bytes, pubkey32: bytes, sighash: bytes) -> bool:
+        self.recorded.append(SigCheck("schnorr", (pubkey32, sig64, sighash)))
+        return True
+
+    def verify_taproot_tweak(self, q: bytes, parity: int, p: bytes, t: bytes) -> bool:
+        self.recorded.append(SigCheck("tweak", (q, parity, p, t)))
+        return True
+
+
+@dataclass
+class _Prepared:
+    result: Optional[BatchResult] = None  # set when failed before batching
+    tx: Optional[Tx] = None
+    txdata: Optional[PrecomputedTxData] = None
+    script_pubkey: bytes = b""
+    amount: int = 0
+    optimistic: Optional[Tuple[bool, ScriptError]] = None
+    checks: List[SigCheck] = field(default_factory=list)
+
+
+def _prepare(item: BatchItem, tx_cache: Dict[bytes, Tx]) -> _Prepared:
+    """Transport-level validation; mirrors bitcoinconsensus.cpp:79-101 check
+    order (flags -> deserialize -> index -> size)."""
+    prep = _Prepared()
+    spent_outputs = None
+    if item.spent_outputs is not None:
+        allowed = ALL_FLAG_BITS
+        spent_outputs = [TxOut(a, s) for a, s in item.spent_outputs]
+    else:
+        allowed = LIBCONSENSUS_FLAGS
+    if item.flags & ~allowed:
+        prep.result = BatchResult(False, Error.ERR_INVALID_FLAGS)
+        return prep
+    try:
+        tx = tx_cache.get(item.spending_tx)
+        if tx is None:
+            tx = Tx.deserialize(item.spending_tx)
+            if len(tx.serialize()) != len(item.spending_tx):
+                prep.result = BatchResult(False, Error.ERR_TX_SIZE_MISMATCH)
+                return prep
+            tx_cache[item.spending_tx] = tx
+        if item.input_index >= len(tx.vin):
+            prep.result = BatchResult(False, Error.ERR_TX_INDEX)
+            return prep
+    except SerializationError:
+        prep.result = BatchResult(False, Error.ERR_TX_DESERIALIZE)
+        return prep
+
+    if spent_outputs is not None:
+        if len(spent_outputs) != len(tx.vin):
+            prep.result = BatchResult(False, Error.ERR_TX_INDEX)
+            return prep
+        prep.txdata = PrecomputedTxData(tx, spent_outputs)
+        prep.script_pubkey = spent_outputs[item.input_index].script_pubkey
+        prep.amount = spent_outputs[item.input_index].value
+    else:
+        if item.flags & VERIFY_TAPROOT:
+            prep.result = BatchResult(False, Error.ERR_AMOUNT_REQUIRED)
+            return prep
+        prep.txdata = PrecomputedTxData(tx)
+        prep.script_pubkey = item.spent_output_script or b""
+        prep.amount = item.amount
+    prep.tx = tx
+    return prep
+
+
+def verify_batch(
+    items: Sequence[BatchItem],
+    verifier: Optional[TpuSecpVerifier] = None,
+) -> List[BatchResult]:
+    """Verify many inputs with one TPU signature dispatch.
+
+    Returns one `BatchResult` per item, bit-identical to the per-input API.
+    """
+    if verifier is None:
+        verifier = default_verifier()
+
+    tx_cache: Dict[bytes, Tx] = {}
+    txdata_cache: Dict[int, PrecomputedTxData] = {}
+    preps = [_prepare(item, tx_cache) for item in items]
+    # Share PrecomputedTxData between items of the same tx (one hash pass
+    # per tx, as in validation.cpp:1538-1549).
+    for prep in preps:
+        if prep.tx is not None and prep.txdata is not None:
+            key = id(prep.tx)
+            cached = txdata_cache.get(key)
+            if cached is not None and cached.spent_outputs_ready >= prep.txdata.spent_outputs_ready:
+                prep.txdata = cached
+            else:
+                txdata_cache[key] = prep.txdata
+
+    # Phase 1: optimistic interpretation, recording curve checks.
+    for item, prep in zip(items, preps):
+        if prep.result is not None:
+            continue
+        checker = DeferringSignatureChecker(
+            prep.tx, item.input_index, prep.amount, prep.txdata
+        )
+        ok, err = verify_script(
+            prep.tx.vin[item.input_index].script_sig,
+            prep.script_pubkey,
+            prep.tx.vin[item.input_index].witness,
+            item.flags,
+            checker,
+        )
+        prep.optimistic = (ok, err)
+        prep.checks = checker.recorded
+
+    # Phase 2: one deduplicated device dispatch for every recorded check.
+    unique: Dict[Tuple, int] = {}
+    ordered: List[SigCheck] = []
+    for prep in preps:
+        for chk in prep.checks:
+            key = (chk.kind, chk.data)
+            if key not in unique:
+                unique[key] = len(ordered)
+                ordered.append(chk)
+    results = verifier.verify_checks(ordered) if ordered else []
+
+    # Phase 3: accept optimistic verdicts; re-run exactly where any curve
+    # check came back False (its result feeds control flow).
+    out: List[BatchResult] = []
+    for item, prep in zip(items, preps):
+        if prep.result is not None:
+            out.append(prep.result)
+            continue
+        all_true = all(
+            results[unique[(chk.kind, chk.data)]] for chk in prep.checks
+        )
+        if all_true:
+            ok, err = prep.optimistic
+        else:
+            checker = TransactionSignatureChecker(
+                prep.tx, item.input_index, prep.amount, prep.txdata
+            )
+            ok, err = verify_script(
+                prep.tx.vin[item.input_index].script_sig,
+                prep.script_pubkey,
+                prep.tx.vin[item.input_index].witness,
+                item.flags,
+                checker,
+            )
+        if ok:
+            out.append(BatchResult.success())
+        else:
+            out.append(BatchResult(False, Error.ERR_SCRIPT, err))
+    return out

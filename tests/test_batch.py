@@ -1,0 +1,216 @@
+"""verify_batch() must agree bit-for-bit with the per-input API.
+
+Mirrors the reference's batch-vs-single seam obligations (SURVEY §4
+implication (4)): same verdicts, same Error codes, same ScriptErrors —
+across P2PKH / P2SH-P2WPKH / P2WSH-multisig (the crate's own end-to-end
+vectors, src/lib.rs:215-277) and synthetic P2TR key-path and script-path
+spends (the taproot capability the reference C ABI cannot reach, §3.2).
+"""
+
+import hashlib
+import struct
+
+import pytest
+
+from conftest import *  # noqa: F401,F403 (env setup)
+
+from bitcoinconsensus_tpu import api
+from bitcoinconsensus_tpu.api import ConsensusError, Error
+from bitcoinconsensus_tpu.core.flags import (
+    VERIFY_ALL_EXTENDED,
+    VERIFY_ALL_LIBCONSENSUS,
+)
+from bitcoinconsensus_tpu.core.script import OP_CHECKSIG, push_data
+from bitcoinconsensus_tpu.core.script_error import ScriptError
+from bitcoinconsensus_tpu.core.sighash import (
+    SIGHASH_ALL,
+    SIGHASH_DEFAULT,
+    PrecomputedTxData,
+    SigVersion,
+    bip143_sighash,
+    bip341_sighash,
+)
+from bitcoinconsensus_tpu.core.tx import OutPoint, Tx, TxIn, TxOut
+from bitcoinconsensus_tpu.crypto import secp_host as H
+from bitcoinconsensus_tpu.models.batch import BatchItem, verify_batch
+from bitcoinconsensus_tpu.utils.hashes import hash160, tagged_hash
+
+from test_api_verify import (
+    P2PKH_SPENDING,
+    P2PKH_SPENT,
+    P2SH_P2WPKH_SPENDING,
+    P2SH_P2WPKH_SPENT,
+    P2WSH_SPENDING,
+    P2WSH_SPENT,
+)
+
+
+def _sk(seed: str) -> int:
+    return int.from_bytes(hashlib.sha256(seed.encode()).digest(), "big") % H.N
+
+
+def _prevout(seed: str) -> OutPoint:
+    return OutPoint(hashlib.sha256(seed.encode()).digest(), 0)
+
+
+def make_p2wpkh_spend(seed: str, amount: int = 50_000, corrupt: bool = False):
+    """Synthetic P2WPKH funding + spend, signed via our own BIP143 sighash."""
+    sk = _sk(seed)
+    pub = H.pubkey_create(sk)
+    spk = b"\x00\x14" + hash160(pub)
+    tx = Tx(
+        version=2,
+        vin=[TxIn(_prevout(seed))],
+        vout=[TxOut(amount - 1000, b"\x51")],
+        locktime=0,
+    )
+    script_code = (
+        b"\x76\xa9" + push_data(hash160(pub)) + b"\x88\xac"
+    )  # DUP HASH160 <h> EQUALVERIFY CHECKSIG
+    sighash = bip143_sighash(script_code, tx, 0, SIGHASH_ALL, amount)
+    sig = H.sign_ecdsa(sk, sighash) + bytes([SIGHASH_ALL])
+    if corrupt:
+        sig = sig[:10] + bytes([sig[10] ^ 1]) + sig[11:]
+    tx.vin[0].witness = [sig, pub]
+    return tx.serialize(), spk, amount
+
+
+def make_p2tr_keypath_spend(seed: str, amount: int = 70_000, corrupt: bool = False):
+    """Synthetic taproot key-path spend (BIP86-style tweak, no script tree)."""
+    d = _sk(seed)
+    px, parity = H.xonly_pubkey_create(d)
+    d_even = d if parity == 0 else H.N - d
+    t = int.from_bytes(tagged_hash("TapTweak", px), "big") % H.N
+    out_sk = (d_even + t) % H.N
+    qx, _ = H.xonly_pubkey_create(out_sk)
+    spk = b"\x51\x20" + qx
+    tx = Tx(version=2, vin=[TxIn(_prevout(seed))], vout=[TxOut(amount - 500, b"\x51")], locktime=0)
+    txdata = PrecomputedTxData(tx, [TxOut(amount, spk)], force=True)
+    sighash = bip341_sighash(tx, 0, SIGHASH_DEFAULT, SigVersion.TAPROOT, txdata, False, b"")
+    sig = H.sign_schnorr(out_sk, sighash)
+    if corrupt:
+        sig = sig[:40] + bytes([sig[40] ^ 2]) + sig[41:]
+    tx.vin[0].witness = [sig]
+    return tx.serialize(), spk, amount
+
+
+def make_p2tr_scriptpath_spend(seed: str, amount: int = 90_000, corrupt: bool = False):
+    """Synthetic taproot script-path spend: single tapscript leaf
+    `<xonly> OP_CHECKSIG`, empty merkle path."""
+    internal = _sk(seed + "/internal")
+    leaf_sk = _sk(seed + "/leaf")
+    ix, _ = H.xonly_pubkey_create(internal)
+    lx, _ = H.xonly_pubkey_create(leaf_sk)
+    script = push_data(lx) + bytes([OP_CHECKSIG])
+    from bitcoinconsensus_tpu.core.serialize import ser_string
+
+    tapleaf = tagged_hash("TapLeaf", bytes([0xC0]) + ser_string(script))
+    t = int.from_bytes(tagged_hash("TapTweak", ix + tapleaf), "big") % H.N
+    base = H.lift_x(int.from_bytes(ix, "big"))
+    Q = H.PointJ.from_affine(*base).add(H.G.mul(t)).to_affine()
+    qx, qy = Q
+    spk = b"\x51\x20" + qx.to_bytes(32, "big")
+    control = bytes([0xC0 | (qy & 1)]) + ix
+    tx = Tx(version=2, vin=[TxIn(_prevout(seed))], vout=[TxOut(amount - 500, b"\x51")], locktime=0)
+    txdata = PrecomputedTxData(tx, [TxOut(amount, spk)], force=True)
+    sighash = bip341_sighash(
+        tx, 0, SIGHASH_DEFAULT, SigVersion.TAPSCRIPT, txdata, False, b"",
+        tapleaf_hash=tapleaf,
+    )
+    sig = H.sign_schnorr(leaf_sk, sighash)
+    if corrupt:
+        sig = sig[:5] + bytes([sig[5] ^ 8]) + sig[6:]
+    tx.vin[0].witness = [sig, script, control]
+    return tx.serialize(), spk, amount
+
+
+def _single_verdict(item: BatchItem):
+    """Run the per-input API on one BatchItem -> (ok, Error, ScriptError)."""
+    try:
+        if item.spent_outputs is not None:
+            api.verify_with_spent_outputs(
+                item.spending_tx, item.input_index, item.spent_outputs, item.flags
+            )
+        else:
+            api.verify_with_flags(
+                item.spent_output_script,
+                item.amount,
+                item.spending_tx,
+                item.input_index,
+                item.flags,
+            )
+        return True, Error.ERR_OK, ScriptError.OK
+    except ConsensusError as e:
+        return False, e.code, e.script_error
+
+
+def _legacy_item(spent_hex, amount, spending_hex, index=0, flags=VERIFY_ALL_LIBCONSENSUS):
+    return BatchItem(
+        spending_tx=bytes.fromhex(spending_hex),
+        input_index=index,
+        flags=flags,
+        spent_output_script=bytes.fromhex(spent_hex),
+        amount=amount,
+    )
+
+
+def _taproot_item(tx_bytes, spk, amount):
+    return BatchItem(
+        spending_tx=tx_bytes,
+        input_index=0,
+        flags=VERIFY_ALL_EXTENDED,
+        spent_outputs=[(amount, spk)],
+    )
+
+
+def test_batch_matches_single_mixed():
+    items = [
+        _legacy_item(P2PKH_SPENT, 0, P2PKH_SPENDING),
+        _legacy_item(P2SH_P2WPKH_SPENT, 1900000, P2SH_P2WPKH_SPENDING),
+        _legacy_item(P2WSH_SPENT, 18393430, P2WSH_SPENDING),
+        # failures: corrupted script, wrong amount, bad index, bad flags
+        _legacy_item(P2PKH_SPENT[:8] + "00" + P2PKH_SPENT[10:], 0, P2PKH_SPENDING),
+        _legacy_item(P2SH_P2WPKH_SPENT, 900000, P2SH_P2WPKH_SPENDING),
+        _legacy_item(P2PKH_SPENT, 0, P2PKH_SPENDING, index=5),
+        _legacy_item(P2PKH_SPENT, 0, P2PKH_SPENDING, flags=1 << 30),
+    ]
+    for seed in ("w1", "w2"):
+        txb, spk, amt = make_p2wpkh_spend(seed)
+        items.append(
+            BatchItem(txb, 0, VERIFY_ALL_LIBCONSENSUS, spent_output_script=spk, amount=amt)
+        )
+    txb, spk, amt = make_p2wpkh_spend("w3", corrupt=True)
+    items.append(BatchItem(txb, 0, VERIFY_ALL_LIBCONSENSUS, spent_output_script=spk, amount=amt))
+    for seed, make, corrupt in (
+        ("t1", make_p2tr_keypath_spend, False),
+        ("t2", make_p2tr_keypath_spend, True),
+        ("t3", make_p2tr_scriptpath_spend, False),
+        ("t4", make_p2tr_scriptpath_spend, True),
+    ):
+        txb, spk, amt = make(seed, corrupt=corrupt)
+        items.append(_taproot_item(txb, spk, amt))
+
+    got = verify_batch(items)
+    for i, item in enumerate(items):
+        ok, err, serr = _single_verdict(item)
+        assert got[i].ok == ok, f"item {i}: ok {got[i].ok} != {ok}"
+        assert got[i].error == err, f"item {i}: {got[i].error} != {err}"
+        if not ok and err == Error.ERR_SCRIPT:
+            assert got[i].script_error == serr, (
+                f"item {i}: {got[i].script_error} != {serr}"
+            )
+
+
+def test_batch_empty():
+    assert verify_batch([]) == []
+
+
+def test_taproot_single_api_roundtrip():
+    txb, spk, amt = make_p2tr_keypath_spend("roundtrip")
+    api.verify_with_spent_outputs(txb, 0, [(amt, spk)])
+    txb, spk, amt = make_p2tr_scriptpath_spend("roundtrip2")
+    api.verify_with_spent_outputs(txb, 0, [(amt, spk)])
+    txb, spk, amt = make_p2tr_keypath_spend("roundtrip3", corrupt=True)
+    with pytest.raises(ConsensusError) as ei:
+        api.verify_with_spent_outputs(txb, 0, [(amt, spk)])
+    assert ei.value.script_error == ScriptError.SCHNORR_SIG
