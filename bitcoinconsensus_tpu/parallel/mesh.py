@@ -1,0 +1,97 @@
+"""Multi-chip scaling: batch sharding over a device mesh + XLA collectives.
+
+The reference has no distributed backend at all — its parallel axis is a
+thread pool draining per-input checks (`checkqueue.h:29-163`). The TPU-native
+equivalent (SURVEY §2.2) shards the *signature-check batch* across chips:
+
+- a 1-D ``Mesh`` over a ``batch`` axis (data parallelism is the only axis
+  with meaning here: lanes are independent; there is no gradient/activation
+  traffic analogue),
+- ``jax.jit`` with ``NamedSharding`` in/out specs so XLA partitions the
+  verify kernel SPMD across the mesh (collective-free: embarrassingly
+  parallel compute),
+- a ``shard_map`` reduction step that AND-reduces per-lane verdicts into a
+  block-level verdict with ``psum`` over ICI — the analogue of
+  `CCheckQueueControl::Wait()`'s all-inputs-valid barrier
+  (`checkqueue.h:139-142,188-195`).
+
+Multi-host: the same mesh spec over `jax.devices()` spanning hosts rides
+ICI/DCN transparently through pjit — no NCCL/MPI translation layer exists or
+is needed.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.experimental.shard_map import shard_map
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..crypto.jax_backend import SigCheck, TpuSecpVerifier, _verify_kernel
+
+__all__ = ["make_mesh", "ShardedSecpVerifier", "make_sharded_step"]
+
+
+def make_mesh(n_devices: Optional[int] = None, axis: str = "batch") -> Mesh:
+    """1-D device mesh over the batch axis."""
+    devs = jax.devices()
+    if n_devices is not None:
+        devs = devs[:n_devices]
+    return Mesh(np.asarray(devs), (axis,))
+
+
+def make_sharded_step(mesh: Mesh):
+    """The full multichip verify step, jitted over `mesh`.
+
+    Returns ``step(a, b, px, py, t1, t2, parity, valid) -> (per_lane, all_ok)``
+    where inputs are batch-sharded, `per_lane` comes back batch-sharded, and
+    `all_ok` is a replicated scalar produced by a psum AND-reduction inside
+    shard_map (the cross-chip collective).
+    """
+    axis = mesh.axis_names[0]
+    lane_sharding = NamedSharding(mesh, P(axis, None))
+    flat_sharding = NamedSharding(mesh, P(axis))
+    replicated = NamedSharding(mesh, P())
+
+    def reduce_all(ok_local):
+        # ok_local: this shard's verdicts. all-valid <=> no failures anywhere.
+        failures = jnp.sum(jnp.where(ok_local, 0, 1))
+        return jax.lax.psum(failures, axis) == 0
+
+    reduce_sharded = shard_map(
+        reduce_all, mesh=mesh, in_specs=P(axis), out_specs=P()
+    )
+
+    def step(a, b, px, py, t1, t2, parity, valid):
+        per_lane = _verify_kernel(a, b, px, py, t1, t2, parity, valid)
+        return per_lane, reduce_sharded(per_lane)
+
+    return jax.jit(
+        step,
+        in_shardings=(lane_sharding,) * 6 + (flat_sharding, flat_sharding),
+        out_shardings=(flat_sharding, replicated),
+    )
+
+
+class ShardedSecpVerifier(TpuSecpVerifier):
+    """Drop-in TpuSecpVerifier that spreads each dispatch over a mesh."""
+
+    def __init__(self, mesh: Optional[Mesh] = None, min_batch: int = 8,
+                 max_batch: int = 1 << 16):
+        super().__init__(min_batch=min_batch, max_batch=max_batch)
+        self.mesh = mesh if mesh is not None else make_mesh()
+        n = self.mesh.devices.size
+        # Batch sizes must divide evenly across the mesh.
+        while self._min_batch % n:
+            self._min_batch *= 2
+        self._step = make_sharded_step(self.mesh)
+        self._kernel = lambda *args: self._step(*args)[0]
+
+    def verify_checks_with_verdict(self, checks: Sequence[SigCheck]):
+        """(per-check results, block-level all-ok) in one sharded dispatch."""
+        res = self.verify_checks(checks)
+        return res, bool(res.all())

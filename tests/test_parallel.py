@@ -1,0 +1,47 @@
+"""Multi-chip sharding tests on the 8-device virtual CPU mesh (conftest)."""
+
+import numpy as np
+
+from conftest import *  # noqa: F401,F403 (sets XLA_FLAGS before jax import)
+
+
+def test_dryrun_multichip():
+    import sys
+    import os
+
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    import __graft_entry__ as g
+
+    g.dryrun_multichip(8)
+
+
+def test_sharded_matches_unsharded():
+    import hashlib
+
+    from bitcoinconsensus_tpu.crypto import secp_host as H
+    from bitcoinconsensus_tpu.crypto.jax_backend import SigCheck, TpuSecpVerifier
+    from bitcoinconsensus_tpu.parallel.mesh import ShardedSecpVerifier, make_mesh
+
+    checks = []
+    for i in range(10):
+        sk = (i * 7919 + 3) % (H.N - 1) + 1
+        msg = hashlib.sha256(b"shard-%d" % i).digest()
+        if i % 2:
+            xpk, _ = H.xonly_pubkey_create(sk)
+            sig = H.sign_schnorr(sk, msg)
+            if i == 5:
+                sig = sig[:8] + bytes([sig[8] ^ 1]) + sig[9:]
+            checks.append(SigCheck("schnorr", (xpk, sig, msg)))
+        else:
+            pub = H.pubkey_create(sk)
+            sig = H.sign_ecdsa(sk, msg)
+            if i == 4:
+                msg = hashlib.sha256(b"other").digest()
+            checks.append(SigCheck("ecdsa", (pub, sig, msg)))
+
+    plain = TpuSecpVerifier().verify_checks(checks)
+    sharded = ShardedSecpVerifier(make_mesh(8))
+    res, all_ok = sharded.verify_checks_with_verdict(checks)
+    assert np.array_equal(plain, res)
+    assert not all_ok  # lanes 4 and 5 are corrupted
+    assert list(np.nonzero(~res)[0]) == [4, 5]
