@@ -17,7 +17,7 @@ import time
 
 
 TARGET = 50_000.0  # verifies/sec, driver-set north star
-BATCH = 4096
+BATCH = 8192
 UNIQUE = 96  # unique signatures; repeated to fill the batch (device work
 # is identical per lane either way; host prep still runs per lane)
 

@@ -37,10 +37,21 @@ import jax
 import jax.numpy as jnp
 
 from ..utils.hashes import tagged_hash
-from ..ops.limbs import NLIMB, P_INT, int_to_limbs
-
+from ..ops.limbs import (
+    NLIMB,
+    P_INT,
+    fe_add,
+    fe_canon,
+    fe_is_zero,
+    fe_mul,
+    fe_sqr,
+    fe_sqrt,
+    fe_sub,
+    int_to_limbs,
+    ints_to_limbs_batch,
+)
 from ..ops.curve import G_X, G_Y, double_scalar_mult, jacobian_to_affine
-from .secp_host import N, lift_x, parse_der_lax, parse_pubkey
+from .secp_host import N, parse_der_lax
 
 __all__ = ["SigCheck", "TpuSecpVerifier", "default_verifier"]
 
@@ -91,7 +102,7 @@ _SENTINEL = P_INT  # never equals a canonical field element (< p)
 
 
 class _Lane:
-    __slots__ = ("valid", "a", "b", "px", "py", "t1", "t2", "parity")
+    __slots__ = ("valid", "a", "b", "px", "py", "want_odd", "t1", "t2", "parity")
 
     def __init__(self):
         # Invalid-lane defaults: 0·G + 0·G, impossible targets.
@@ -100,17 +111,45 @@ class _Lane:
         self.b = 0
         self.px = G_X
         self.py = G_Y
+        self.want_odd = -1  # -1: py holds the full y; 0/1: lift on device
         self.t1 = _SENTINEL
         self.t2 = _SENTINEL
         self.parity = -1  # -1: don't care
+
+
+def _host_parse_pubkey(lane: _Lane, pubkey: bytes) -> bool:
+    """Structural half of secp256k1_ec_pubkey_parse (eckey_impl.h): length,
+    prefix, range and (for uncompressed forms) on-curve/hybrid checks. The
+    expensive decompression square root runs on device (fe_sqrt)."""
+    if len(pubkey) == 33 and pubkey[0] in (2, 3):
+        x = int.from_bytes(pubkey[1:], "big")
+        if x >= P_INT:
+            return False
+        lane.px = x
+        lane.py = 0
+        lane.want_odd = 1 if pubkey[0] == 3 else 0
+        return True
+    if len(pubkey) == 65 and pubkey[0] in (4, 6, 7):
+        x = int.from_bytes(pubkey[1:33], "big")
+        y = int.from_bytes(pubkey[33:], "big")
+        if x >= P_INT or y >= P_INT:
+            return False
+        if (y * y - (x * x % P_INT * x + 7)) % P_INT != 0:
+            return False
+        if pubkey[0] == 6 and (y & 1):
+            return False
+        if pubkey[0] == 7 and not (y & 1):
+            return False
+        lane.px, lane.py, lane.want_odd = x, y, -1
+        return True
+    return False
 
 
 def _prep_ecdsa(lane: _Lane, pubkey: bytes, sig_der: bytes, msg32: bytes):
     """Mirror of CPubKey::Verify host half (pubkey.cpp:191-207): parse
     pubkey, lax-DER parse, normalize S; u1/u2 are filled in later after the
     batched inversion. Returns s for the inversion batch, or None."""
-    pt = parse_pubkey(pubkey)
-    if pt is None:
+    if not _host_parse_pubkey(lane, pubkey):
         return None
     rs = parse_der_lax(sig_der)
     if rs is None:
@@ -119,8 +158,9 @@ def _prep_ecdsa(lane: _Lane, pubkey: bytes, sig_der: bytes, msg32: bytes):
     if s > N // 2:
         s = N - s  # normalize high-S (pubkey.cpp:204)
     if r == 0 or s == 0:
+        lane.want_odd = -1  # lane stays invalid; restore defaults
+        lane.px, lane.py = G_X, G_Y
         return None
-    lane.px, lane.py = pt
     lane.t1 = r
     lane.t2 = r + N if r + N < P_INT else _SENTINEL
     lane.valid = True
@@ -131,8 +171,8 @@ def _prep_schnorr(lane: _Lane, pubkey32: bytes, sig64: bytes, msg32: bytes):
     """BIP340 verify host half (modules/schnorrsig/main_impl.h:190-237)."""
     if len(pubkey32) != 32 or len(sig64) != 64:
         return
-    pt = lift_x(int.from_bytes(pubkey32, "big"))
-    if pt is None:
+    px = int.from_bytes(pubkey32, "big")
+    if px >= P_INT:
         return
     r = int.from_bytes(sig64[:32], "big")
     s = int.from_bytes(sig64[32:], "big")
@@ -141,7 +181,8 @@ def _prep_schnorr(lane: _Lane, pubkey32: bytes, sig64: bytes, msg32: bytes):
     e = int.from_bytes(
         tagged_hash("BIP0340/challenge", sig64[:32] + pubkey32 + msg32), "big"
     ) % N
-    lane.px, lane.py = pt
+    lane.px, lane.py = px, 0
+    lane.want_odd = 0  # BIP340 lift_x: even y; device checks existence
     lane.a = s
     lane.b = (N - e) % N  # (n-e)·P = -e·P
     lane.t1 = r
@@ -153,14 +194,15 @@ def _prep_tweak(lane: _Lane, tweaked32: bytes, parity: int, internal32: bytes,
                 tweak32: bytes):
     """Taproot commitment check host half (extrakeys/main_impl.h:109-129):
     Q = P_internal + t·G must equal (tweaked_x, parity)."""
-    pt = lift_x(int.from_bytes(internal32, "big"))
-    if pt is None:
+    px = int.from_bytes(internal32, "big")
+    if px >= P_INT:
         return
     t = int.from_bytes(tweak32, "big")
     if t >= N:
         return
     tx = int.from_bytes(tweaked32, "big")
-    lane.px, lane.py = pt
+    lane.px, lane.py = px, 0
+    lane.want_odd = 0  # x-only internal key: even-y lift, device-checked
     lane.a = t
     lane.b = 1
     lane.t1 = tx if tx < P_INT else _SENTINEL
@@ -168,9 +210,26 @@ def _prep_tweak(lane: _Lane, tweaked32: bytes, parity: int, internal32: bytes,
     lane.valid = True
 
 
-def _verify_kernel(a, b, px, py, t1, t2, parity_req, valid):
-    """Device side: R = a·G + b·P; accept per lane against targets."""
-    X, Y, Z = double_scalar_mult(a, b, px, py)
+_SEVEN_LIMBS = int_to_limbs(7)
+
+
+def _verify_kernel(a, b, px, py, want_odd, t1, t2, parity_req, valid):
+    """Device side: decompress P where needed (fe_sqrt; the host only does
+    structural parsing), then R = a·G + b·P and per-lane acceptance."""
+    import jax.numpy as _jnp
+
+    seven = _jnp.broadcast_to(_jnp.asarray(_SEVEN_LIMBS), px.shape).astype(px.dtype)
+    rhs = fe_add(fe_mul(fe_sqr(px), px), seven)  # x^3 + 7
+    ycand = fe_canon(fe_sqrt(rhs))
+    sq_ok = fe_is_zero(fe_sub(fe_mul(ycand, ycand), rhs))
+    odd = (ycand[..., 0] & 1) == 1
+    yneg = fe_canon(fe_sub(_jnp.zeros_like(ycand), ycand))
+    flip = odd != (want_odd == 1)
+    ylift = _jnp.where(flip[..., None], yneg, ycand)
+    need = want_odd >= 0
+    py_eff = _jnp.where(need[..., None], ylift, py)
+    valid = valid & (~need | sq_ok)
+    X, Y, Z = double_scalar_mult(a, b, px, py_eff)
     x, y, inf = jacobian_to_affine(X, Y, Z)
     ok_x = jnp.all(x == t1, axis=-1) | jnp.all(x == t2, axis=-1)
     y_odd = (y[..., 0] & 1) == 1
@@ -226,15 +285,12 @@ class TpuSecpVerifier:
     def _dispatch(self, lanes: List[_Lane]) -> np.ndarray:
         n = len(lanes)
         size = self._pad(n)
+        pad = size - n
 
         def fill(get, pad_value):
-            arr = np.zeros((size, NLIMB), dtype=np.int32)
-            for i, lane in enumerate(lanes):
-                arr[i] = int_to_limbs(get(lane))
-            if pad_value is not None:
-                for i in range(n, size):
-                    arr[i] = int_to_limbs(pad_value)
-            return arr
+            return ints_to_limbs_batch(
+                [get(lane) for lane in lanes] + [pad_value] * pad
+            )
 
         a = fill(lambda l: l.a, 0)
         b = fill(lambda l: l.b, 0)
@@ -242,12 +298,15 @@ class TpuSecpVerifier:
         py = fill(lambda l: l.py, G_Y)
         t1 = fill(lambda l: l.t1, _SENTINEL)
         t2 = fill(lambda l: l.t2, _SENTINEL)
-        parity = np.full(size, -1, dtype=np.int32)
+        want_odd = np.fromiter(
+            (lane.want_odd for lane in lanes), dtype=np.int32, count=n
+        )
+        want_odd = np.concatenate([want_odd, np.full(pad, -1, np.int32)])
+        parity = np.fromiter((lane.parity for lane in lanes), np.int32, count=n)
+        parity = np.concatenate([parity, np.full(pad, -1, np.int32)])
         valid = np.zeros(size, dtype=bool)
-        for i, lane in enumerate(lanes):
-            parity[i] = lane.parity
-            valid[i] = lane.valid
-        res = self._kernel(a, b, px, py, t1, t2, parity, valid)
+        valid[:n] = [lane.valid for lane in lanes]
+        res = self._kernel(a, b, px, py, want_odd, t1, t2, parity, valid)
         return np.asarray(res)[:n]
 
     # Convenience single-check wrappers (used by tests/differential fuzzing).

@@ -1,0 +1,54 @@
+"""Generate the fixed-base G window table artifact (_gtable.npz).
+
+64 windows of 4 bits: window w holds the 15 affine multiples
+k * (16^w * G), k = 1..15, as radix-2^13 limb vectors. This is the TPU-era
+analogue of the reference's ecmult precomputation
+(`secp256k1_ecmult_context_build`, `secp256k1/src/ecmult_impl.h:312-350`):
+device-resident multiples of G so the fixed-base half of
+u1*G + u2*P needs no doublings at all — 64 table adds per lane.
+
+Size: 2 x 64 x 15 x 20 int32 ≈ 153 KiB. Deterministic; regenerate with
+`python -m bitcoinconsensus_tpu.ops.gen_gtable` (validated by tests).
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+from ..crypto.secp_host import G, PointJ
+from .limbs import NLIMB, int_to_limbs
+
+WINDOWS = 64
+WINDOW_BITS = 4
+ENTRIES = (1 << WINDOW_BITS) - 1  # 15 (entry 0 = infinity, never stored)
+
+ARTIFACT = os.path.join(os.path.dirname(__file__), "_gtable.npz")
+
+
+def build_tables():
+    """Returns (gx, gy): (64, 15, 20) int32 limb arrays."""
+    gx = np.zeros((WINDOWS, ENTRIES, NLIMB), dtype=np.int32)
+    gy = np.zeros((WINDOWS, ENTRIES, NLIMB), dtype=np.int32)
+    base = G
+    for w in range(WINDOWS):
+        acc = PointJ.infinity()
+        for k in range(ENTRIES):
+            acc = acc.add(base)
+            aff = acc.to_affine()
+            assert aff is not None  # k*16^w*G is never infinity (k < n)
+            gx[w, k] = int_to_limbs(aff[0])
+            gy[w, k] = int_to_limbs(aff[1])
+        base = acc.add(base)  # 16^{w+1} * G = 15*16^w*G + 16^w*G
+    return gx, gy
+
+
+def main() -> None:
+    gx, gy = build_tables()
+    np.savez_compressed(ARTIFACT, gx=gx, gy=gy)
+    print(f"wrote {ARTIFACT} ({os.path.getsize(ARTIFACT)} bytes)")
+
+
+if __name__ == "__main__":
+    main()

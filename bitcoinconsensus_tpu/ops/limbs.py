@@ -56,8 +56,12 @@ __all__ = [
     "fe_mul_small",
     "fe_canon",
     "fe_is_zero",
+    "fe_is_zero_pair",
     "fe_eq",
     "fe_inv",
+    "fe_pow_const",
+    "fe_sqrt",
+    "ints_to_limbs_batch",
 ]
 
 NLIMB = 20
@@ -175,38 +179,30 @@ def _settle(x, bounds: Bounds):
             x, bounds = _pass(x, bounds)
         else:
             x, bounds = _fold_high(x, bounds)
-    # Phase B1: sequential exact carry over the 20 limbs, catching overflow.
+    # Phase B: one sequential exact carry over the 20 limbs (the only exact
+    # absorber the parallel bound domain cannot replace), then fold the two
+    # kinds of overflow — bits 256..259 of limb 19 via 2^256 ≡ C, and the
+    # carry past limb 19 via 2^260 ≡ 16C — and absorb with a 5-step chain.
+    # The top-fold runs *before* the carry-fold so the value stays < 3p
+    # (2^256 + 15C + c*16C) with no second wrap.
     total = _total(bounds)
     c_max = total >> (RADIX * NLIMB)  # bound on the carry past limb 19
     assert c_max * 7440 < 2**31
-
-    def exact_pass(cols_in):
-        out, carry = [], None
-        for i in range(NLIMB):
-            v = cols_in[i] if carry is None else cols_in[i] + carry
-            out.append(v & MASK)
-            carry = v >> RADIX
-        return out, carry
-
-    cols, carry = exact_pass([x[..., i] for i in range(NLIMB)])
-    if c_max > 0:
-        # B2: fold carry * 2^260 ≡ carry * 16C back into limbs 0..2, redo the
-        # exact pass. A second overflow carry c2 <= 1 remains *only if* the
-        # first fold wrapped, in which case the low limbs are tiny (< 2^39) —
-        # so folding c2 unconditionally and absorbing with the short carry
-        # chain below is exact even though per-limb bounds can't show it.
-        for j, f in enumerate(_FOLD260):
-            cols[j] = cols[j] + carry * f
-        cols, c2 = exact_pass(cols)
-        for j, f in enumerate(_FOLD260):
-            cols[j] = cols[j] + c2 * f
-    # B4: fold bits >= 256 (top 4 bits of limb 19) via 2^256 ≡ C.
+    cols = []
+    carry = None
+    for i in range(NLIMB):
+        v = x[..., i] if carry is None else x[..., i] + carry
+        cols.append(v & MASK)
+        carry = v >> RADIX
     hi4 = cols[19] >> 9
     cols[19] = cols[19] & 0x1FF
     cols[0] = cols[0] + hi4 * 977
     cols[2] = cols[2] + hi4 * 64
-    # B5: short sequential carry over limbs 0..4; remaining carry <= 1 lands
-    # in limb 5, which stays <= 2^13 (weak invariant allows it).
+    if c_max > 0:
+        for j, f in enumerate(_FOLD260):
+            cols[j] = cols[j] + carry * f
+    # Short chain: limbs 0..4; remaining carry <= 1 lands in limb 5, which
+    # stays <= 2^13 (the weak invariant allows it).
     carry = None
     for i in range(5):
         v = cols[i] if carry is None else cols[i] + carry
@@ -296,9 +292,39 @@ def fe_canon(a):
     return _cond_sub_p(x)
 
 
+_2P_LIMBS = int_to_limbs(2 * P_INT)
+
+
+def _is_zero_exact(z):
+    """Exact-13-bit-limbed z (value < 3p): is z ≡ 0 mod p?
+
+    The exact representation is unique per value, so z ≡ 0 iff its limbs
+    match 0, p, or 2p — no conditional subtractions needed.
+    """
+    p1 = jnp.asarray(_P_LIMBS)
+    p2 = jnp.asarray(_2P_LIMBS)
+    return (
+        jnp.all(z == 0, axis=-1)
+        | jnp.all(z == p1, axis=-1)
+        | jnp.all(z == p2, axis=-1)
+    )
+
+
 def fe_is_zero(a):
     """a ≡ 0 mod p? Returns (...,) bool."""
-    return jnp.all(fe_canon(a) == 0, axis=-1)
+    return _is_zero_exact(_exact_pass(a))
+
+
+def fe_is_zero_pair(u, v):
+    """(u ≡ 0, v ≡ 0) sharing one carry chain (group-op hot path)."""
+    z = _is_zero_exact(_exact_pass(jnp.stack([u, v], axis=0)))
+    return z[0], z[1]
+
+
+def fe_is_zero_many(vals):
+    """Zero tests for a sequence of elements, one shared carry chain."""
+    z = _is_zero_exact(_exact_pass(jnp.stack(list(vals), axis=0)))
+    return tuple(z[i] for i in range(len(vals)))
 
 
 def fe_eq(a, b):
@@ -306,15 +332,12 @@ def fe_eq(a, b):
     return jnp.all(fe_canon(a) == fe_canon(b), axis=-1)
 
 
-def fe_inv(a):
-    """a^(p-2) mod p (Fermat inverse; 0 -> 0).
-
-    The exponent is a static constant, so the square/multiply schedule is
-    fixed at trace time (~255 squarings + ~240 multiplies, traced once).
-    """
+def fe_pow_const(a, e: int):
+    """a^e mod p for a static exponent (square-and-multiply under lax.scan;
+    the schedule is fixed at trace time and the graph stays tiny)."""
     from jax import lax
 
-    bits = jnp.asarray([int(c) for c in bin(P_INT - 2)[2:]], dtype=jnp.int32)
+    bits = jnp.asarray([int(c) for c in bin(e)[2:]], dtype=jnp.int32)
 
     def body(acc, bit):
         acc = fe_sqr(acc)
@@ -322,3 +345,27 @@ def fe_inv(a):
 
     acc, _ = lax.scan(body, a, bits[1:])
     return acc
+
+
+def fe_inv(a):
+    """a^(p-2) mod p (Fermat inverse; 0 -> 0)."""
+    return fe_pow_const(a, P_INT - 2)
+
+
+def fe_sqrt(a):
+    """Candidate square root a^((p+1)/4) (p ≡ 3 mod 4). The caller must
+    check candidate^2 == a; for non-residues the candidate is garbage."""
+    return fe_pow_const(a, (P_INT + 1) // 4)
+
+
+def ints_to_limbs_batch(vals) -> np.ndarray:
+    """Vectorized host packing: list of ints (< 2^257) -> (n, 20) int32."""
+    raw = b"".join(v.to_bytes(33, "little") for v in vals)
+    nb = np.frombuffer(raw, dtype=np.uint8).reshape(-1, 33).astype(np.int64)
+    limbs = np.empty((len(vals), NLIMB), dtype=np.int32)
+    for i in range(NLIMB):
+        bitpos = RADIX * i
+        k, sh = bitpos >> 3, bitpos & 7
+        window = nb[:, k] | (nb[:, k + 1] << 8) | (nb[:, k + 2] << 16)
+        limbs[:, i] = (window >> sh) & MASK
+    return limbs

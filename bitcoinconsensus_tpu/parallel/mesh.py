@@ -66,13 +66,16 @@ def make_sharded_step(mesh: Mesh):
         reduce_all, mesh=mesh, in_specs=P(axis), out_specs=P()
     )
 
-    def step(a, b, px, py, t1, t2, parity, valid):
-        per_lane = _verify_kernel(a, b, px, py, t1, t2, parity, valid)
+    def step(a, b, px, py, want_odd, t1, t2, parity, valid):
+        per_lane = _verify_kernel(a, b, px, py, want_odd, t1, t2, parity, valid)
         return per_lane, reduce_sharded(per_lane)
 
     return jax.jit(
         step,
-        in_shardings=(lane_sharding,) * 6 + (flat_sharding, flat_sharding),
+        in_shardings=(lane_sharding,) * 4
+        + (flat_sharding,)
+        + (lane_sharding,) * 2
+        + (flat_sharding, flat_sharding),
         out_shardings=(flat_sharding, replicated),
     )
 
