@@ -6,22 +6,31 @@ The reference verifies one signature per call on one core
 `secp256k1_xonly_pubkey_tweak_add_check`, `modules/extrakeys/main_impl.h:109`).
 All three reduce to the same algebra — compute R = a·G + b·P and compare R
 against a target — so this backend folds a *mixed* batch of all three check
-kinds into ONE device dispatch of the `double_scalar_mult` kernel:
+kinds into ONE device program over `double_scalar_mult`:
 
     kind      a        b      P            accept
-    ECDSA     m/s      r/s    pubkey       R.x ≡ r (mod n)      [x==r or x==r+n]
+    ECDSA     m/s      r/s    pubkey       R.x ∈ {r, r+n} (mod p)
     Schnorr   s        n-e    lift_x(pk)   R.x == r and even(R.y)
-    tweak     t        1      lift_x(pki)  R.x == out_x and parity(R.y) matches
+    tweak     t        1      lift_x(pki)  R.x == out_x and parity matches
+
+The host→device link, not device compute, is the scarce resource (the
+device sits behind a narrow tunnel; one mixed batch is ~4k field muls per
+lane on a VPU that does them in microseconds). Hence:
+
+- **Byte-packed transfers**: each check ships as 4 x 32-byte fields
+  (a, b, pubkey-x, target) + 4 flag bytes — 132 B/lane instead of ~500 B
+  of pre-split limbs. Limb splitting, y-lifting (fe_sqrt), and the r+n
+  secondary target all happen on device.
+- **Pipelined chunk dispatch**: large batches go out in chunks whose
+  transfers/compute overlap the host-side prep of the next chunk (JAX
+  async dispatch); the per-roundtrip sync cost is paid once.
 
 Host-side prep (byte parsing, lax-DER, batched modular inverse of s, BIP340
 challenge hashes) is branchy and tiny; device-side is the uniform 256-bit
-double-and-add — the split the SURVEY §7 architecture prescribes. Lanes that
-fail host-side structural checks get a dummy point and a False mask; the
-per-lane accept targets use a sentinel (p itself, never produced by a
-canonical field element) to encode "no secondary target".
-
-Batches are padded to the next power of two (>= 8) so jit caches a handful
-of shapes. Results are bit-identical to the host oracle
+double-and-add — the split the SURVEY §7 architecture prescribes. Lanes
+that fail host-side structural checks get dummy field values and a False
+mask. Batches are padded to the next power of two (>= min_batch) so jit
+caches a handful of shapes. Results are bit-identical to the host oracle
 (`crypto/secp_host.py`), which is itself differentially tested against the
 consensus vectors.
 """
@@ -37,9 +46,12 @@ import jax
 import jax.numpy as jnp
 
 from ..utils.hashes import tagged_hash
+from ..utils.profiling import Phases
 from ..ops.limbs import (
+    MASK,
     NLIMB,
     P_INT,
+    bytes_to_limbs,
     fe_add,
     fe_canon,
     fe_is_zero,
@@ -48,15 +60,14 @@ from ..ops.limbs import (
     fe_sqrt,
     fe_sub,
     int_to_limbs,
-    ints_to_limbs_batch,
 )
 from ..ops.curve import G_X, G_Y, double_scalar_mult, jacobian_to_affine
 from .secp_host import N, parse_der_lax
 
 __all__ = ["SigCheck", "TpuSecpVerifier", "default_verifier"]
 
-# Persistent XLA compilation cache: the verify kernel is large (a 256-step
-# double-and-add body); caching makes every process after the first fast.
+# Persistent XLA compilation cache: the verify kernel is a large traced
+# program; caching makes every process after the first fast.
 _CACHE_DIR = os.environ.get(
     "BITCOINCONSENSUS_TPU_CACHE", os.path.expanduser("~/.cache/bitcoinconsensus_tpu_xla")
 )
@@ -98,23 +109,28 @@ def _batch_inv_mod_n(vals: List[int]) -> List[int]:
     return out
 
 
-_SENTINEL = P_INT  # never equals a canonical field element (< p)
-
-
 class _Lane:
-    __slots__ = ("valid", "a", "b", "px", "py", "want_odd", "t1", "t2", "parity")
+    """Host-parsed check, ready for byte packing.
+
+    a, b: scalars (< n); px: the point's x coordinate; want_odd: parity of
+    the y lift (valid pubkeys always resolve to a parity — uncompressed
+    keys are curve-checked on host, so y is recomputable from its parity);
+    t1: the x-coordinate target; has_t2 marks the ECDSA r+n secondary
+    target (only when r + n < p); parity_req constrains R.y parity
+    (-1 don't care / 0 even / 1 odd).
+    """
+
+    __slots__ = ("valid", "a", "b", "px", "want_odd", "t1", "has_t2", "parity")
 
     def __init__(self):
-        # Invalid-lane defaults: 0·G + 0·G, impossible targets.
         self.valid = False
         self.a = 0
         self.b = 0
         self.px = G_X
-        self.py = G_Y
-        self.want_odd = -1  # -1: py holds the full y; 0/1: lift on device
-        self.t1 = _SENTINEL
-        self.t2 = _SENTINEL
-        self.parity = -1  # -1: don't care
+        self.want_odd = 0
+        self.t1 = 0
+        self.has_t2 = 0
+        self.parity = -1
 
 
 def _host_parse_pubkey(lane: _Lane, pubkey: bytes) -> bool:
@@ -126,7 +142,6 @@ def _host_parse_pubkey(lane: _Lane, pubkey: bytes) -> bool:
         if x >= P_INT:
             return False
         lane.px = x
-        lane.py = 0
         lane.want_odd = 1 if pubkey[0] == 3 else 0
         return True
     if len(pubkey) == 65 and pubkey[0] in (4, 6, 7):
@@ -140,7 +155,9 @@ def _host_parse_pubkey(lane: _Lane, pubkey: bytes) -> bool:
             return False
         if pubkey[0] == 7 and not (y & 1):
             return False
-        lane.px, lane.py, lane.want_odd = x, y, -1
+        # y is on-curve, hence exactly the lift of its own parity: the
+        # device recomputes it from (x, want_odd) — y itself never ships.
+        lane.px, lane.want_odd = x, y & 1
         return True
     return False
 
@@ -148,7 +165,7 @@ def _host_parse_pubkey(lane: _Lane, pubkey: bytes) -> bool:
 def _prep_ecdsa(lane: _Lane, pubkey: bytes, sig_der: bytes, msg32: bytes):
     """Mirror of CPubKey::Verify host half (pubkey.cpp:191-207): parse
     pubkey, lax-DER parse, normalize S; u1/u2 are filled in later after the
-    batched inversion. Returns s for the inversion batch, or None."""
+    batched inversion. Returns (r, s, m) for the inversion batch, or None."""
     if not _host_parse_pubkey(lane, pubkey):
         return None
     rs = parse_der_lax(sig_der)
@@ -158,11 +175,9 @@ def _prep_ecdsa(lane: _Lane, pubkey: bytes, sig_der: bytes, msg32: bytes):
     if s > N // 2:
         s = N - s  # normalize high-S (pubkey.cpp:204)
     if r == 0 or s == 0:
-        lane.want_odd = -1  # lane stays invalid; restore defaults
-        lane.px, lane.py = G_X, G_Y
         return None
     lane.t1 = r
-    lane.t2 = r + N if r + N < P_INT else _SENTINEL
+    lane.has_t2 = 1 if r + N < P_INT else 0
     lane.valid = True
     return r, s, int.from_bytes(msg32, "big") % N
 
@@ -181,12 +196,12 @@ def _prep_schnorr(lane: _Lane, pubkey32: bytes, sig64: bytes, msg32: bytes):
     e = int.from_bytes(
         tagged_hash("BIP0340/challenge", sig64[:32] + pubkey32 + msg32), "big"
     ) % N
-    lane.px, lane.py = px, 0
+    lane.px = px
     lane.want_odd = 0  # BIP340 lift_x: even y; device checks existence
     lane.a = s
     lane.b = (N - e) % N  # (n-e)·P = -e·P
     lane.t1 = r
-    lane.parity = 0  # require even y
+    lane.parity = 0  # require even R.y
     lane.valid = True
 
 
@@ -201,50 +216,70 @@ def _prep_tweak(lane: _Lane, tweaked32: bytes, parity: int, internal32: bytes,
     if t >= N:
         return
     tx = int.from_bytes(tweaked32, "big")
-    lane.px, lane.py = px, 0
+    lane.px = px
     lane.want_odd = 0  # x-only internal key: even-y lift, device-checked
     lane.a = t
     lane.b = 1
-    lane.t1 = tx if tx < P_INT else _SENTINEL
+    # tx >= p can never equal a canonical x coordinate; the raw compare
+    # below is False for such lanes with no sentinel machinery.
+    lane.t1 = tx
     lane.parity = parity & 1
     lane.valid = True
 
 
 _SEVEN_LIMBS = int_to_limbs(7)
+_N_LIMBS = int_to_limbs(N)
 
 
-def _verify_kernel(a, b, px, py, want_odd, t1, t2, parity_req, valid):
-    """Device side: decompress P where needed (fe_sqrt; the host only does
-    structural parsing), then R = a·G + b·P and per-lane acceptance."""
-    import jax.numpy as _jnp
+def _verify_kernel(fields, want_odd, parity_req, has_t2, valid):
+    """Device side of the mixed verify batch.
 
-    seven = _jnp.broadcast_to(_jnp.asarray(_SEVEN_LIMBS), px.shape).astype(px.dtype)
+    fields: (B, 4, 32) uint8 — little-endian (a, b, px, t1) per lane.
+    Unpacks to limb-major (20, B), lifts P's y from (px, want_odd) via
+    fe_sqrt, runs R = a·G + b·P, and accepts per lane:
+    R.x == t1, or (has_t2) R.x == t1 + n, with optional R.y parity."""
+    a = bytes_to_limbs(fields[:, 0])
+    b = bytes_to_limbs(fields[:, 1])
+    px = bytes_to_limbs(fields[:, 2])
+    t1 = bytes_to_limbs(fields[:, 3])
+
+    seven = jnp.broadcast_to(
+        jnp.asarray(_SEVEN_LIMBS).reshape(NLIMB, 1), px.shape
+    ).astype(px.dtype)
     rhs = fe_add(fe_mul(fe_sqr(px), px), seven)  # x^3 + 7
     ycand = fe_canon(fe_sqrt(rhs))
     sq_ok = fe_is_zero(fe_sub(fe_mul(ycand, ycand), rhs))
-    odd = (ycand[..., 0] & 1) == 1
-    yneg = fe_canon(fe_sub(_jnp.zeros_like(ycand), ycand))
+    odd = (ycand[0] & 1) == 1
+    yneg = fe_sub(jnp.zeros_like(ycand), ycand)  # weak rep is fine here
     flip = odd != (want_odd == 1)
-    ylift = _jnp.where(flip[..., None], yneg, ycand)
-    need = want_odd >= 0
-    py_eff = _jnp.where(need[..., None], ylift, py)
-    valid = valid & (~need | sq_ok)
-    X, Y, Z = double_scalar_mult(a, b, px, py_eff)
+    py = jnp.where(flip[None], yneg, ycand)
+    valid = valid & sq_ok
+
+    X, Y, Z = double_scalar_mult(a, b, px, py)
     x, y, inf = jacobian_to_affine(X, Y, Z)
-    ok_x = jnp.all(x == t1, axis=-1) | jnp.all(x == t2, axis=-1)
-    y_odd = (y[..., 0] & 1) == 1
+
+    nl = jnp.broadcast_to(
+        jnp.asarray(_N_LIMBS).reshape(NLIMB, 1), t1.shape
+    ).astype(t1.dtype)
+    t1n = fe_canon(t1 + nl, bounds=[2 * MASK] * NLIMB)  # r+n (< p when used)
+    ok_x = jnp.all(x == t1, axis=0) | (
+        (has_t2 == 1) & jnp.all(x == t1n, axis=0)
+    )
+    y_odd = (y[0] & 1) == 1
     par_ok = (parity_req < 0) | (y_odd == (parity_req == 1))
     return valid & ~inf & ok_x & par_ok
 
 
 class TpuSecpVerifier:
     """Batched verifier; pads to power-of-two batch shapes and jits once per
-    shape (persistent XLA cache across processes)."""
+    shape (persistent XLA cache across processes). Large batches are split
+    into `chunk` -lane dispatches pipelined back-to-back."""
 
-    def __init__(self, min_batch: int = 8, max_batch: int = 1 << 16):
+    def __init__(self, min_batch: int = 8, chunk: int = 1 << 13):
         self._kernel = jax.jit(_verify_kernel)
         self._min_batch = min_batch
-        self._max_batch = max_batch
+        self._chunk = chunk
+        self.phases = Phases()  # host_prep / pack / dispatch / sync
 
     def _pad(self, n: int) -> int:
         size = self._min_batch
@@ -252,10 +287,7 @@ class TpuSecpVerifier:
             size *= 2
         return size
 
-    def verify_checks(self, checks: Sequence[SigCheck]) -> np.ndarray:
-        """Verify a mixed batch; returns bool array aligned with `checks`."""
-        if not checks:
-            return np.zeros(0, dtype=bool)
+    def _prep_lanes(self, checks: Sequence[SigCheck]) -> List["_Lane"]:
         lanes = [_Lane() for _ in checks]
         ecdsa_pending = []  # (lane, r, s, m)
         for lane, chk in zip(lanes, checks):
@@ -272,42 +304,61 @@ class TpuSecpVerifier:
             for (lane, r, _s, m), sinv in zip(ecdsa_pending, sinvs):
                 lane.a = m * sinv % N  # u1
                 lane.b = r * sinv % N  # u2
+        return lanes
+
+    def verify_checks(self, checks: Sequence[SigCheck]) -> np.ndarray:
+        """Verify a mixed batch; returns bool array aligned with `checks`.
+
+        Fully pipelined per chunk: while the device crunches chunk k, the
+        host parses/packs chunk k+1 (JAX async dispatch); the roundtrip
+        sync cost is paid once, at the end.
+        """
+        if not checks:
+            return np.zeros(0, dtype=bool)
+        pending = []  # (device_result, start, count)
+        for start in range(0, len(checks), self._chunk):
+            sub_checks = checks[start : start + self._chunk]
+            with self.phases("host_prep"):
+                sub = self._prep_lanes(sub_checks)
+            with self.phases("pack"):
+                args = self._pack_lanes(sub)
+            with self.phases("dispatch"):
+                pending.append((self._run_kernel(args, len(sub)), start, len(sub)))
         out = np.zeros(len(checks), dtype=bool)
-        todo = [i for i, lane in enumerate(lanes) if lane.valid]
-        if not todo:
-            return out
-        # Device dispatch (chunked at max_batch to bound memory).
-        for start in range(0, len(todo), self._max_batch):
-            idx = todo[start : start + self._max_batch]
-            out[idx] = self._dispatch([lanes[i] for i in idx])
+        with self.phases("sync"):
+            for res, start, count in pending:
+                out[start : start + count] = np.asarray(res)[:count]
         return out
 
-    def _dispatch(self, lanes: List[_Lane]) -> np.ndarray:
+    def _pack_lanes(self, lanes: List["_Lane"]):
         n = len(lanes)
         size = self._pad(n)
-        pad = size - n
+        raw = bytearray(size * 4 * 32)
+        pos = 0
+        for lane in lanes:
+            raw[pos : pos + 32] = lane.a.to_bytes(32, "little")
+            raw[pos + 32 : pos + 64] = lane.b.to_bytes(32, "little")
+            raw[pos + 64 : pos + 96] = lane.px.to_bytes(32, "little")
+            raw[pos + 96 : pos + 128] = lane.t1.to_bytes(32, "little")
+            pos += 128
+        fields = np.frombuffer(bytes(raw), dtype=np.uint8).reshape(size, 4, 32)
 
-        def fill(get, pad_value):
-            return ints_to_limbs_batch(
-                [get(lane) for lane in lanes] + [pad_value] * pad
-            )
+        def flag(get, pad_value):
+            arr = np.fromiter((get(l) for l in lanes), dtype=np.int32, count=n)
+            return np.concatenate([arr, np.full(size - n, pad_value, np.int32)])
 
-        a = fill(lambda l: l.a, 0)
-        b = fill(lambda l: l.b, 0)
-        px = fill(lambda l: l.px, G_X)
-        py = fill(lambda l: l.py, G_Y)
-        t1 = fill(lambda l: l.t1, _SENTINEL)
-        t2 = fill(lambda l: l.t2, _SENTINEL)
-        want_odd = np.fromiter(
-            (lane.want_odd for lane in lanes), dtype=np.int32, count=n
-        )
-        want_odd = np.concatenate([want_odd, np.full(pad, -1, np.int32)])
-        parity = np.fromiter((lane.parity for lane in lanes), np.int32, count=n)
-        parity = np.concatenate([parity, np.full(pad, -1, np.int32)])
+        want_odd = flag(lambda l: l.want_odd, 0)
+        parity = flag(lambda l: l.parity, -1)
+        has_t2 = flag(lambda l: l.has_t2, 0)
         valid = np.zeros(size, dtype=bool)
         valid[:n] = [lane.valid for lane in lanes]
-        res = self._kernel(a, b, px, py, want_odd, t1, t2, parity, valid)
-        return np.asarray(res)[:n]
+        return fields, want_odd, parity, has_t2, valid
+
+    def _run_kernel(self, args: Tuple, n: int):
+        """Dispatch seam: subclasses (mesh sharding) override to add a live
+        mask / collective verdict. `n` is the count of real (unpadded)
+        lanes. Returns the (async) device result."""
+        return self._kernel(*args)
 
     # Convenience single-check wrappers (used by tests/differential fuzzing).
     def verify_ecdsa(self, pubkey: bytes, sig_der: bytes, msg32: bytes) -> bool:

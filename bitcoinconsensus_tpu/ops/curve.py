@@ -1,24 +1,25 @@
 """Batched secp256k1 group ops and double-scalar multiplication for TPU.
 
-Points are Jacobian triples ``(X, Y, Z)`` of weak field elements (see
-`limbs.py`), batched over leading axes; ``Z ≡ 0`` encodes infinity. All
-control flow is branchless: exceptional cases of the addition law (equal
-points, negated points, infinity operands) are computed alongside the
-generic formula and chosen with masks, so one traced program is
-consensus-exact for *every* lane — the TPU-native replacement for the
-reference's per-case branches in `secp256k1/src/group_impl.h`.
+Points are Jacobian triples ``(X, Y, Z)`` of weak field elements in the
+limb-major layout of `limbs.py` — shape ``(20, B)`` with the batch in the
+lane axis; ``Z ≡ 0`` encodes infinity. All control flow is branchless:
+exceptional cases of the addition law (equal points, negated points,
+infinity operands) are computed alongside the generic formula and chosen
+with masks, so one traced program is consensus-exact for *every* lane —
+the TPU-native replacement for the reference's per-case branches in
+`secp256k1/src/group_impl.h`.
 
 The verify workload is R = a·G + b·P per lane (`secp256k1_ecmult`,
-`secp256k1/src/ecmult_impl.h:561-580`). The reference runs Strauss-wNAF per
-call on one core; here every lane advances in lockstep on the VPU through a
-*windowed* schedule:
+`secp256k1/src/ecmult_impl.h:561-580`). The reference runs Strauss-wNAF
+per call on one core; here every lane advances in lockstep on the VPU:
 
-- fixed-base half a·G: 64 4-bit windows against a device-resident table of
-  affine multiples k·16^w·G (the ecmult_context_build analogue, generated by
-  `gen_gtable.py`) — 64 complete mixed additions, zero doublings;
-- variable-base half b·P: per-lane Jacobian table {1..15}·P (14 mixed
-  additions), then 64 windows of 4 doublings + one complete Jacobian
-  addition with a one-hot table select;
+- fixed-base half a·G: 32 8-bit windows against a device-resident table
+  of affine multiples k·256^w·G (the ecmult_context_build analogue,
+  `gen_gtable.py`) — 32 complete mixed additions, zero doublings; the
+  one-hot row select runs as an exact f32 matmul on the MXU;
+- variable-base half b·P: per-lane Jacobian table {0..15}·P built by a
+  14-step `lax.scan`, then 64 windows of 4 doublings + one complete
+  Jacobian addition with a one-hot table select;
 - one final complete addition joins the halves.
 
 No secret data is involved on the verify path, so uniform (non-constant-
@@ -36,9 +37,11 @@ import jax.numpy as jnp
 from jax import lax
 
 from .limbs import (
+    MASK,
     NLIMB,
     RADIX,
     fe_add,
+    fe_batch_inv,
     fe_canon,
     fe_inv,
     fe_is_zero,
@@ -70,8 +73,15 @@ _GY_LIMBS = int_to_limbs(G_Y)
 _ONE = int_to_limbs(1)
 
 NBITS = NLIMB * RADIX  # 260 bit positions per scalar (top 4 always zero)
-WINDOWS = 64
-WINDOW_BITS = 4
+P_WINDOWS = 64
+P_WINDOW_BITS = 4
+G_WINDOWS = 32
+G_WINDOW_BITS = 8
+
+
+def _col(vec: np.ndarray, like):
+    """Constant limb vector -> (20, 1, ..., 1) broadcastable column."""
+    return jnp.asarray(vec).reshape((NLIMB,) + (1,) * (like.ndim - 1))
 
 
 def jacobian_double(X, Y, Z):
@@ -84,26 +94,26 @@ def jacobian_double(X, Y, Z):
     F = fe_sqr(E)
     X3 = fe_sub(F, fe_mul_small(D, 2))
     Y3 = fe_sub(fe_mul(E, fe_sub(D, X3)), fe_mul_small(C, 8))
-    Z3 = fe_mul_small(fe_mul(Y, Z), 2)  # Z=0 -> Z3=0: infinity is preserved
+    Z3 = fe_mul_small(fe_mul(Y, Z), 2)  # Z=0 -> Z3=0: infinity preserved
     return X3, Y3, Z3
 
 
 def _select(mask, a3, b3):
     """Per-lane select between two point triples; mask shape (...,)."""
-    m = mask[..., None]
+    m = mask[None]
     return tuple(jnp.where(m, x, y) for x, y in zip(a3, b3))
 
 
 def _inf_like(X):
     zeros = jnp.zeros_like(X)
-    ones = jnp.broadcast_to(jnp.asarray(_ONE), X.shape).astype(X.dtype)
+    ones = jnp.broadcast_to(_col(_ONE, X), X.shape).astype(X.dtype)
     return ones, ones, zeros
 
 
 def jacobian_madd_complete(X1, Y1, Z1, x2, y2):
     """Complete mixed addition (X1,Y1,Z1) + (x2,y2), (x2,y2) affine and
-    never infinity. Branchless handling of every exceptional case; generic
-    path is madd-2007-bl (the math of `secp256k1_gej_add_ge_var`,
+    never infinity. Branchless handling of every exceptional case; the
+    generic path is madd-2007-bl (the math of `secp256k1_gej_add_ge_var`,
     vectorized and de-branched)."""
     Z1Z1 = fe_sqr(Z1)
     U2 = fe_mul(x2, Z1Z1)
@@ -123,7 +133,7 @@ def jacobian_madd_complete(X1, Y1, Z1, x2, y2):
     out = (X3, Y3, Z3)
 
     dbl = jacobian_double(X1, Y1, Z1)
-    ones = jnp.broadcast_to(jnp.asarray(_ONE), X1.shape).astype(X1.dtype)
+    ones = jnp.broadcast_to(_col(_ONE, X1), X1.shape).astype(X1.dtype)
     lift = (jnp.broadcast_to(x2, X1.shape).astype(X1.dtype),
             jnp.broadcast_to(y2, X1.shape).astype(X1.dtype), ones)
 
@@ -168,30 +178,33 @@ def jacobian_add_complete(X1, Y1, Z1, X2, Y2, Z2, inf2):
 
 
 def scalar_bits(limbs):
-    """(..., 20) scalar limbs -> (..., 260) bits, LSB first."""
-    shifts = jnp.arange(RADIX, dtype=jnp.int32)
-    bits = (limbs[..., :, None] >> shifts) & 1
-    return bits.reshape(bits.shape[:-2] + (NBITS,))
+    """(20, ...) scalar limbs -> (260, ...) bits, LSB first."""
+    shifts = jnp.arange(RADIX, dtype=jnp.int32).reshape(
+        (1, RADIX) + (1,) * (limbs.ndim - 1)
+    )
+    bits = (limbs[:, None] >> shifts) & 1
+    return bits.reshape((NBITS,) + limbs.shape[1:])
 
 
-def _digits4(limbs):
-    """(..., 20) scalar limbs -> (..., 64) 4-bit window digits, LSB first."""
-    bits = scalar_bits(limbs)[..., :256]
-    b = bits.reshape(bits.shape[:-1] + (WINDOWS, WINDOW_BITS))
-    weights = jnp.asarray([1, 2, 4, 8], dtype=jnp.int32)
-    return jnp.sum(b * weights, axis=-1)
+def _digits(limbs, width: int, count: int):
+    """(20, ...) scalar limbs -> (count, ...) window digits, LSB first."""
+    bits = scalar_bits(limbs)[:256]
+    b = bits.reshape((count, width) + limbs.shape[1:])
+    weights = jnp.asarray([1 << i for i in range(width)], dtype=jnp.int32)
+    weights = weights.reshape((1, width) + (1,) * (limbs.ndim - 1))
+    return jnp.sum(b * weights, axis=1)
 
 
 _GTABLE = None
 
 
 def _g_table():
-    """(64, 15, 20) x2 affine G window table. Cached as numpy (host) so no
+    """(32, 255, 20) x2 affine G window table. Cached as numpy (host) so no
     traced value ever leaks into the cache; jnp conversion happens at the
     use site inside whatever trace is active."""
     global _GTABLE
     if _GTABLE is None:
-        path = os.path.join(os.path.dirname(__file__), "_gtable.npz")
+        path = os.path.join(os.path.dirname(__file__), "_gtable8.npz")
         if os.path.exists(path):
             data = np.load(path)
             gx, gy = data["gx"], data["gy"]
@@ -203,64 +216,90 @@ def _g_table():
     return jnp.asarray(_GTABLE[0]), jnp.asarray(_GTABLE[1])
 
 
+def _fixed_base_mult(a_digits):
+    """RG = a·G from 8-bit window digits (32, B): 32 complete madds, no
+    doublings. The per-window row select is an exact f32 matmul
+    (one-hot (255, B) against the (255, 20) window table): 13-bit limbs
+    are exact in f32, and the contraction feeds the MXU instead of
+    per-lane gathers."""
+    gx_t, gy_t = _g_table()
+    gx_f = gx_t.astype(jnp.float32)  # (32, 255, 20)
+    gy_f = gy_t.astype(jnp.float32)
+    k255 = jnp.arange(1, 256, dtype=jnp.int32)[:, None]  # (255, 1)
+
+    def body(i, RG):
+        da = a_digits[i]  # (B,)
+        oh = (da[None, :] == k255).astype(jnp.float32)  # (255, B)
+        gxw = lax.dynamic_index_in_dim(gx_f, i, axis=0, keepdims=False)
+        gyw = lax.dynamic_index_in_dim(gy_f, i, axis=0, keepdims=False)
+        # Precision.HIGHEST is load-bearing: the TPU MXU lowers default-
+        # precision f32 dots to bfloat16 passes (8-bit mantissa), which
+        # silently truncates 13-bit limbs.
+        selx = jnp.dot(gxw.T, oh, preferred_element_type=jnp.float32,
+                       precision=lax.Precision.HIGHEST)
+        sely = jnp.dot(gyw.T, oh, preferred_element_type=jnp.float32,
+                       precision=lax.Precision.HIGHEST)
+        selx = selx.astype(jnp.int32)  # (20, B), exact
+        sely = sely.astype(jnp.int32)
+        RGa = jacobian_madd_complete(*RG, selx, sely)
+        return _select(da > 0, RGa, RG)
+
+    zeros = jnp.zeros_like(a_digits[0])
+    inf = _inf_like(zeros[None].repeat(NLIMB, axis=0))
+    return lax.fori_loop(0, G_WINDOWS, body, inf)
+
+
+def _p_table(px, py):
+    """Per-lane Jacobian table T[k] = k·P, k = 0..15, via a 14-step scan
+    (T[0] = infinity, T[1] = P). Returns (16, 20, B) coord stacks."""
+    ones = jnp.broadcast_to(_col(_ONE, px), px.shape).astype(px.dtype)
+    inf = _inf_like(px)
+
+    def step(carry, _):
+        nxt = jacobian_madd_complete(*carry, px, py)
+        return nxt, nxt
+
+    _, tail = lax.scan(step, (px, py, ones), None, length=14)
+    TX = jnp.concatenate([inf[0][None], px[None], tail[0]], axis=0)
+    TY = jnp.concatenate([inf[1][None], py[None], tail[1]], axis=0)
+    TZ = jnp.concatenate([inf[2][None], ones[None], tail[2]], axis=0)
+    return TX, TY, TZ
+
+
 def double_scalar_mult(a, b, px, py):
     """R = a·G + b·P per lane (the ECDSA/Schnorr verify hot kernel).
 
-    `a`, `b`: (..., 20) scalar limb vectors (< 2^256). `px`, `py`: (..., 20)
-    affine point, never infinity (host substitutes a dummy for invalid lanes
-    and masks them). Returns a Jacobian triple.
+    `a`, `b`: (20, ...) scalar limb vectors, **reduced mod n** (the group
+    order; the final join assumes a·G is infinite iff a ≡ 0). `px`, `py`:
+    (20, ...) affine point, never infinity (the host substitutes a dummy
+    for invalid lanes and masks them). Returns a Jacobian triple.
 
-    Schedule per lane: 14 madds (P table) + 64x(4 doublings + 1 complete
-    J-add + 1 complete madd) + 1 final join — ~5.5k field muls, vs ~11.7k
-    for the naive bitwise ladder (`double_scalar_mult_bits`).
+    Schedule per lane: 14 madds (P table, lax.scan) + 64x(4 doublings +
+    1 complete J-add) + 32 G madds (MXU-select) + 1 final join.
     """
-    digits_a = _digits4(a)  # (B, 64) — drives the fixed-base G half
-    digits_b = _digits4(b)  # (B, 64) — drives the variable-base P half
-    gx_t, gy_t = _g_table()
+    digits_b = _digits(b, P_WINDOW_BITS, P_WINDOWS)  # (64, B)
+    digits_a = _digits(a, G_WINDOW_BITS, G_WINDOWS)  # (32, B)
 
-    ones = jnp.broadcast_to(jnp.asarray(_ONE), px.shape).astype(px.dtype)
-    inf = _inf_like(px)
+    TX, TY, TZ = _p_table(px, py)
+    k16 = jnp.arange(16, dtype=jnp.int32).reshape((16,) + (1,) * px.ndim)
 
-    # Per-lane Jacobian table T[k] = k*P, k = 0..15 (T[0] = infinity).
-    entries = [inf, (px, py, ones)]
-    for _ in range(2, 16):
-        entries.append(jacobian_madd_complete(*entries[-1], px, py))
-    TX = jnp.stack([e[0] for e in entries])  # (16, B, 20)
-    TY = jnp.stack([e[1] for e in entries])
-    TZ = jnp.stack([e[2] for e in entries])
-    k16 = jnp.arange(16, dtype=jnp.int32)
-    k15 = jnp.arange(1, 16, dtype=jnp.int32)
-
-    def body(i, carry):
-        R, RG = carry[:3], carry[3:]
-        w = 63 - i
-        # Variable-base: R = 16R + T[digit_b[w]].
+    def body(i, R):
+        w = P_WINDOWS - 1 - i
         R = jacobian_double(*R)
         R = jacobian_double(*R)
         R = jacobian_double(*R)
         R = jacobian_double(*R)
-        db = lax.dynamic_index_in_dim(digits_b, w, axis=-1, keepdims=False)
-        oh = (db[None, :, None] == k16[:, None, None]).astype(jnp.int32)
+        db = digits_b[w]  # (B,)
+        oh = (db[None] == k16).astype(jnp.int32)  # (16, 1, B)
         selx = jnp.sum(TX * oh, axis=0)
         sely = jnp.sum(TY * oh, axis=0)
         selz = jnp.sum(TZ * oh, axis=0)
-        R = jacobian_add_complete(*R, selx, sely, selz, db == 0)
-        # Fixed-base: RG += Gtable[w][digit_a[w]] (no doublings).
-        da = lax.dynamic_index_in_dim(digits_a, w, axis=-1, keepdims=False)
-        gxw = lax.dynamic_index_in_dim(gx_t, w, axis=0, keepdims=False)
-        gyw = lax.dynamic_index_in_dim(gy_t, w, axis=0, keepdims=False)
-        ohg = (da[:, None] == k15[None, :]).astype(jnp.int32)  # (B, 15)
-        selgx = jnp.matmul(ohg, gxw)  # (B, 20)
-        selgy = jnp.matmul(ohg, gyw)
-        RGa = jacobian_madd_complete(*RG, selgx, selgy)
-        RG = _select(da > 0, RGa, RG)
-        return (*R, *RG)
+        return jacobian_add_complete(*R, selx, sely, selz, db == 0)
 
-    init = (*inf, *inf)
-    out = lax.fori_loop(0, WINDOWS, body, init)
-    R, RG = out[:3], out[3:]
-    # Join halves. RG is infinite iff a had no nonzero digit (precomputable).
-    rg_inf = jnp.all(digits_a == 0, axis=-1)
+    R = lax.fori_loop(0, P_WINDOWS, body, _inf_like(px))
+    RG = _fixed_base_mult(digits_a)
+    # Join halves. RG is infinite iff a had no nonzero digit.
+    rg_inf = jnp.all(digits_a == 0, axis=0)
     return jacobian_add_complete(*R, *RG, rg_inf)
 
 
@@ -269,32 +308,33 @@ def double_scalar_mult_bits(a, b, px, py):
     schedule for differential tests against the windowed kernel."""
     bits_a = scalar_bits(a)
     bits_b = scalar_bits(b)
-    gx = jnp.broadcast_to(jnp.asarray(_GX_LIMBS), px.shape).astype(px.dtype)
-    gy = jnp.broadcast_to(jnp.asarray(_GY_LIMBS), py.shape).astype(py.dtype)
-    init = _inf_like(px)
+    gx = jnp.broadcast_to(_col(_GX_LIMBS, px), px.shape).astype(px.dtype)
+    gy = jnp.broadcast_to(_col(_GY_LIMBS, py), py.shape).astype(py.dtype)
 
     def body(i, R):
         t = 255 - i
         R = jacobian_double(*R)
-        ba = lax.dynamic_index_in_dim(bits_a, t, axis=-1, keepdims=False)
         Ra = jacobian_madd_complete(*R, gx, gy)
-        R = _select(ba == 1, Ra, R)
-        bb = lax.dynamic_index_in_dim(bits_b, t, axis=-1, keepdims=False)
+        R = _select(bits_a[t] == 1, Ra, R)
         Rb = jacobian_madd_complete(*R, px, py)
-        R = _select(bb == 1, Rb, R)
+        R = _select(bits_b[t] == 1, Rb, R)
         return R
 
-    return lax.fori_loop(0, 256, body, init)
+    return lax.fori_loop(0, 256, body, _inf_like(px))
 
 
 def jacobian_to_affine(X, Y, Z):
     """(X, Y, Z) -> (x, y, is_infinity) with x, y canonical in [0, p).
 
-    One Fermat inversion per lane (~500 muls, <10% of the windowed
-    schedule). Infinity lanes return x = y = 0 and the mask.
-    """
-    zi = fe_inv(Z)
+    (20, B) batches share one Montgomery-trick inversion across the batch
+    (fe_batch_inv, ~4 muls/lane); other shapes fall back to per-lane
+    Fermat. Infinity lanes return x = y = 0."""
+    inf = fe_is_zero(Z)
+    if Z.ndim == 2:
+        zi = fe_batch_inv(Z, inf)
+    else:
+        zi = fe_inv(Z)
     zi2 = fe_sqr(zi)
     x = fe_canon(fe_mul(X, zi2))
     y = fe_canon(fe_mul(Y, fe_mul(zi2, zi)))
-    return x, y, fe_is_zero(Z)
+    return x, y, inf

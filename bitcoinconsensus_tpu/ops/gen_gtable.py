@@ -1,13 +1,16 @@
-"""Generate the fixed-base G window table artifact (_gtable.npz).
+"""Generate the fixed-base G window table artifact (_gtable8.npz).
 
-64 windows of 4 bits: window w holds the 15 affine multiples
-k * (16^w * G), k = 1..15, as radix-2^13 limb vectors. This is the TPU-era
-analogue of the reference's ecmult precomputation
-(`secp256k1_ecmult_context_build`, `secp256k1/src/ecmult_impl.h:312-350`):
-device-resident multiples of G so the fixed-base half of
-u1*G + u2*P needs no doublings at all — 64 table adds per lane.
+32 windows of 8 bits: window w holds the 255 affine multiples
+k * (256^w * G), k = 1..255, as radix-2^13 limb vectors. This is the
+TPU-era analogue of the reference's ecmult precomputation
+(`secp256k1_ecmult_context_build`, `secp256k1/src/ecmult_impl.h:312-350`;
+the reference's WINDOW_G=15 table is ~1 MiB for the same reason):
+device-resident multiples of G so the fixed-base half of u1*G + u2*P
+needs no doublings and only 32 table adds per lane. The per-window
+one-hot select is an exact f32 matmul (limbs are 13-bit, well inside the
+f32 mantissa) — MXU work, not VPU work.
 
-Size: 2 x 64 x 15 x 20 int32 ≈ 153 KiB. Deterministic; regenerate with
+Size: 2 x 32 x 255 x 20 int32 ≈ 1.3 MiB. Deterministic; regenerate with
 `python -m bitcoinconsensus_tpu.ops.gen_gtable` (validated by tests).
 """
 
@@ -17,30 +20,49 @@ import os
 
 import numpy as np
 
-from ..crypto.secp_host import G, PointJ
+from ..crypto.secp_host import G, P, PointJ
 from .limbs import NLIMB, int_to_limbs
 
-WINDOWS = 64
-WINDOW_BITS = 4
-ENTRIES = (1 << WINDOW_BITS) - 1  # 15 (entry 0 = infinity, never stored)
+WINDOWS = 32
+WINDOW_BITS = 8
+ENTRIES = (1 << WINDOW_BITS) - 1  # 255 (entry 0 = infinity, never stored)
 
-ARTIFACT = os.path.join(os.path.dirname(__file__), "_gtable.npz")
+ARTIFACT = os.path.join(os.path.dirname(__file__), "_gtable8.npz")
+
+
+def _batch_to_affine(points):
+    """Jacobian points -> affine via one Montgomery-trick inversion."""
+    zs = [pt.Z for pt in points]
+    prefix = []
+    acc = 1
+    for z in zs:
+        acc = acc * z % P
+        prefix.append(acc)
+    inv = pow(acc, P - 2, P)
+    out = [None] * len(points)
+    for i in range(len(points) - 1, -1, -1):
+        zi = inv * (prefix[i - 1] if i else 1) % P
+        inv = inv * zs[i] % P
+        zi2 = zi * zi % P
+        out[i] = (points[i].X * zi2 % P, points[i].Y * zi2 * zi % P)
+    return out
 
 
 def build_tables():
-    """Returns (gx, gy): (64, 15, 20) int32 limb arrays."""
+    """Returns (gx, gy): (32, 255, 20) int32 limb arrays."""
     gx = np.zeros((WINDOWS, ENTRIES, NLIMB), dtype=np.int32)
     gy = np.zeros((WINDOWS, ENTRIES, NLIMB), dtype=np.int32)
     base = G
     for w in range(WINDOWS):
+        jac = []
         acc = PointJ.infinity()
-        for k in range(ENTRIES):
+        for _ in range(ENTRIES):
             acc = acc.add(base)
-            aff = acc.to_affine()
-            assert aff is not None  # k*16^w*G is never infinity (k < n)
-            gx[w, k] = int_to_limbs(aff[0])
-            gy[w, k] = int_to_limbs(aff[1])
-        base = acc.add(base)  # 16^{w+1} * G = 15*16^w*G + 16^w*G
+            jac.append(acc)
+        for k, (x, y) in enumerate(_batch_to_affine(jac)):
+            gx[w, k] = int_to_limbs(x)
+            gy[w, k] = int_to_limbs(y)
+        base = acc.add(base)  # 256^{w+1}*G = 255*256^w*G + 256^w*G
     return gx, gy
 
 

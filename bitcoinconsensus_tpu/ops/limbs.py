@@ -1,42 +1,49 @@
-"""Batched 256-bit field arithmetic mod p (secp256k1) for TPU.
+"""Batched 256-bit field arithmetic mod p (secp256k1) for TPU — limb-major.
 
-Design (TPU-first, not a port): a field element is a vector of 20
-little-endian limbs in radix 2^13, dtype int32, batched over arbitrary
-leading axes — shape ``(..., 20)``. Why 13-bit limbs in int32:
+Design (TPU-first, not a port). A field element is 20 little-endian limbs
+in radix 2^13, dtype int32, **limb axis first**: shape ``(20, ...)`` with
+the batch in the trailing (lane) axes. Two hardware facts drive the layout
+and the carry scheme:
 
-- a 13x13-bit product is < 2^26 and a 20-term schoolbook convolution sums to
-  < 20 * 2^26 < 2^31, so every intermediate of a full 256-bit multiply fits a
-  *signed int32 lane* — int32 is the TPU VPU's native element type (TPU has
-  no int64 multiplier; XLA would emulate it slowly).
-- the reference proves the same idea at different widths: its 32-bit build
-  uses 10x26 field limbs / 8x32 scalars (`secp256k1/src/field_10x26_impl.h`,
-  `scalar_8x32_impl.h`); we shrink the radix further so whole products fit a
-  single lane, and vectorize over the *batch* axis instead of over time.
+- The VPU operates on (8, 128) tiles with the *last* dimension mapped to
+  128 lanes. Batch-last means every elementwise op runs at full lane
+  occupancy; the tiny 20-limb axis lives in the sublane dimension. (The
+  transposed layout — limbs last — wastes 108/128 lanes on every op.)
+- There is no 64-bit multiplier. A 13x13-bit product is < 2^26 and a
+  20-term schoolbook column sums to < 2^31, so every intermediate of a
+  256-bit multiply fits a signed int32 lane. The reference proves the
+  same idea at different widths (its 32-bit build uses 10x26 field limbs,
+  `secp256k1/src/field_10x26_impl.h`); we shrink the radix so whole
+  products fit one lane and vectorize over the batch instead of time.
 
-Reduction uses p = 2^256 - C with C = 2^32 + 977, hence
-2^260 ≡ 16C = 2^36 + 15632, which in radix 2^13 is the 3-limb constant
-[7440, 1, 1024] — folding high limbs back down is a tiny convolution.
+Carry handling is *parallel only* — there are no sequential per-limb
+chains anywhere in the hot path:
 
-Carry handling is *parallel*: each pass ships every limb's carry one
-position up simultaneously (a handful of whole-array ops), instead of a
-sequential 20-step scan. Alongside the traced arrays every routine tracks
-static Python-int per-limb upper bounds, so the number of passes, fold
-rounds, and appended carry columns are all decided at trace time and int32
-overflow-freedom is checked by construction (asserts on the bounds).
+- `_pass` ships every limb's carry one position up simultaneously and
+  wraps the carry out of limb 19 back into limbs 0..2 via
+  2^260 ≡ 16C (mod p), C = 2^32 + 977 (16C = 2^36 + 15632, the 3-limb
+  constant [7440, 1, 1024] in radix 2^13) — the pseudo-Mersenne
+  wrap-around pass.
+- Exactness (canonicalization, zero tests) uses a Kogge-Stone
+  carry-lookahead: generate/propagate per limb, log2(20) combine steps,
+  all whole-array ops.
 
-Representation invariant ("weak"): limbs 0..18 in [0, 2^13] (inclusive —
-the parallel passes settle at <= 2^13, which still keeps convolutions
-int32-safe), limb 19 in [0, 2^10], value < 3p, congruent to the element
-mod p. All public ops accept and return weak elements; `fe_canon` produces
-the unique representative in [0, p) with exact 13-bit limbs.
+Alongside the traced arrays every routine tracks static Python-int
+per-limb upper bounds, so pass counts and fold rounds are fixed at trace
+time and int32 overflow-freedom is checked by construction.
+
+Representation invariant ("weak"): per-limb bounds `W2` (the fixpoint of
+the wrap-around pass): limb 0 ≤ 2^13-1+7440, limb 1 ≤ 2^13+1,
+limb 2 ≤ 2^13+1024, limbs 3..19 ≤ 2^13. All public ops accept and return
+weak elements; `fe_canon` produces the unique representative in [0, p).
 
 Spec source: the reference's field semantics (`secp256k1/src/field_*_impl.h`)
-— behavior only; the layout and algorithms here are vectorized-TPU designs.
+— behavior only; layout and algorithms here are TPU designs.
 """
 
 from __future__ import annotations
 
-from typing import List, Tuple
+from typing import List, Sequence, Tuple
 
 import numpy as np
 
@@ -47,6 +54,7 @@ __all__ = [
     "RADIX",
     "MASK",
     "P_INT",
+    "W2",
     "int_to_limbs",
     "limbs_to_int",
     "fe_add",
@@ -56,9 +64,10 @@ __all__ = [
     "fe_mul_small",
     "fe_canon",
     "fe_is_zero",
-    "fe_is_zero_pair",
+    "fe_is_zero_many",
     "fe_eq",
     "fe_inv",
+    "fe_batch_inv",
     "fe_pow_const",
     "fe_sqrt",
     "ints_to_limbs_batch",
@@ -67,15 +76,26 @@ __all__ = [
 NLIMB = 20
 RADIX = 13
 MASK = (1 << RADIX) - 1
-LIMB_SETTLE = MASK + 1  # parallel passes settle limbs at <= 2^13 (inclusive)
 
 P_INT = 2**256 - 2**32 - 977
 _C = 2**32 + 977  # 2^256 mod p
-_16C = 16 * _C  # 2^260 mod p = 2^36 + 15632
-# 16C as radix-2^13 limbs: 15632 = 1*8192 + 7440; 2^36 = 1024 * 2^26.
+# 2^260 mod p = 16C = 2^36 + 15632 -> radix-2^13 limbs [7440, 1, 1024].
 _FOLD260 = (7440, 1, 1024)
-# Weak-form bounds (see _settle): limbs 0..18 <= 2^13, limb 19 <= 2^10.
-_WEAK_BOUNDS = [LIMB_SETTLE] * (NLIMB - 1) + [1 << 10]
+
+# Weak bounds: fixpoint of the wrap-around pass (see _pass). With carries
+# <= 1 in steady state: limb0 <= MASK + 1*7440, limb1 <= MASK + 1 + 1,
+# limb2 <= MASK + 1 + 1*1024, others <= MASK + 1.
+W2 = [MASK + 7440, MASK + 2, MASK + 1025] + [MASK + 1] * (NLIMB - 3)
+
+# Mul safety: every schoolbook column sum must fit int32.
+for _k in range(2 * NLIMB - 1):
+    _col = sum(
+        W2[_i] * W2[_k - _i]
+        for _i in range(max(0, _k - NLIMB + 1), min(NLIMB, _k + 1))
+    )
+    assert _col < 2**31, (_k, _col)
+# Value bound: weak values are < 2^261 (single-carry wrap in _exact260).
+assert sum(w << (RADIX * i) for i, w in enumerate(W2)) < 2**261
 
 
 def int_to_limbs(x: int, n: int = NLIMB) -> np.ndarray:
@@ -90,251 +110,336 @@ def int_to_limbs(x: int, n: int = NLIMB) -> np.ndarray:
 
 
 def limbs_to_int(limbs) -> int:
-    """Host helper: limb vector (last axis) -> Python int."""
+    """Host helper: limb vector (FIRST axis) -> Python int."""
     arr = np.asarray(limbs, dtype=np.int64)
     return sum(int(v) << (RADIX * i) for i, v in enumerate(arr))
 
 
-_P_LIMBS = int_to_limbs(P_INT)
+def ints_to_limbs_batch(vals) -> np.ndarray:
+    """Vectorized host packing: list of ints (< 2^257) -> (n, 20) int32.
 
-
-def _sub_bias_limbs() -> np.ndarray:
-    """A 21-limb encoding of 32p whose limbs 0..19 are all >= 2^13.
-
-    Used as the additive bias in fe_sub so every per-limb difference
-    a_i + bias_i - b_i stays nonnegative (b_i <= 2^13 by the weak invariant),
-    which keeps all carry passes nonnegative.
+    Row-major (one row per value) because that is the natural host order;
+    the device kernel transposes once at entry to the limb-major layout.
     """
-    d = [int(v) for v in int_to_limbs(32 * P_INT, 21)]
+    raw = b"".join(v.to_bytes(33, "little") for v in vals)
+    nb = np.frombuffer(raw, dtype=np.uint8).reshape(-1, 33).astype(np.int64)
+    limbs = np.empty((len(vals), NLIMB), dtype=np.int32)
     for i in range(NLIMB):
-        if d[i] < LIMB_SETTLE:
-            d[i] += 1 << RADIX
-            d[i + 1] -= 1
-    assert all(d[i] >= LIMB_SETTLE for i in range(NLIMB)) and d[20] >= 0
-    assert sum(v << (RADIX * i) for i, v in enumerate(d)) == 32 * P_INT
-    return np.asarray(d, dtype=np.int32)
+        bitpos = RADIX * i
+        k, sh = bitpos >> 3, bitpos & 7
+        window = nb[:, k] | (nb[:, k + 1] << 8) | (nb[:, k + 2] << 16)
+        limbs[:, i] = (window >> sh) & MASK
+    return limbs
 
 
-_SUB_BIAS = _sub_bias_limbs()
+_P_LIMBS = int_to_limbs(P_INT)
 
 Bounds = List[int]
 
 
-def _total(bounds: Bounds) -> int:
-    return sum(b << (RADIX * i) for i, b in enumerate(bounds))
+def bytes_to_limbs(u8):
+    """Device-side unpack: (..., 32) uint8 little-endian 256-bit values ->
+    limb-major (20, ...) int32.
+
+    Transfers over the host->device link are the scarce resource (32 bytes
+    per field instead of 80 bytes of pre-split limbs); the unpack is a
+    handful of static gathers + shifts, so it runs where compute is cheap.
+    """
+    x = u8.astype(jnp.int32)
+    pad = jnp.zeros_like(x[..., :1])
+    x = jnp.concatenate([x, pad], axis=-1)  # (..., 33): limb 19 spans 2 bytes
+    limbs = []
+    for i in range(NLIMB):
+        bitpos = RADIX * i
+        k, sh = bitpos >> 3, bitpos & 7
+        window = x[..., k] | (x[..., k + 1] << 8) | (x[..., k + 2] << 16)
+        limbs.append((window >> sh) & MASK)
+    return jnp.stack(limbs, axis=0)
 
 
-def _pass(x, bounds: Bounds):
-    """One parallel carry pass; may append one carry column."""
+def _zeros_rows(x, n: int):
+    return jnp.zeros((n,) + x.shape[1:], dtype=x.dtype)
+
+
+def _pass(x, bounds: Bounds) -> Tuple[jnp.ndarray, Bounds]:
+    """One parallel carry pass along the limb axis.
+
+    At exactly NLIMB limbs the carry out of limb 19 wraps into limbs 0..2
+    via 16C (value changes by a multiple of p only). With more limbs the
+    top carry appends a column (folded later by _fold_high).
+    """
     assert all(0 <= b < 2**31 for b in bounds)
+    n = x.shape[0]
     c = x >> RADIX
     kept = x & MASK
+    out = kept + jnp.concatenate([_zeros_rows(x, 1), c[:-1]], axis=0)
     cb = [b >> RADIX for b in bounds]
-    zero = jnp.zeros_like(c[..., :1])
-    x2 = kept + jnp.concatenate([zero, c[..., :-1]], axis=-1)
     b2 = [min(bounds[0], MASK)] + [
-        min(bounds[i], MASK) + cb[i - 1] for i in range(1, len(bounds))
+        min(bounds[i], MASK) + cb[i - 1] for i in range(1, n)
     ]
-    if cb[-1] > 0:
-        x2 = jnp.concatenate([x2, c[..., -1:]], axis=-1)
-        b2.append(cb[-1])
-    return x2, b2
+    top = c[n - 1]
+    if cb[-1] == 0:
+        return out, b2
+    if n == NLIMB:
+        wrap = jnp.stack(
+            [top * _FOLD260[0], top * _FOLD260[1], top * _FOLD260[2]], axis=0
+        )
+        out = out + jnp.concatenate([wrap, _zeros_rows(x, NLIMB - 3)], axis=0)
+        for j, f in enumerate(_FOLD260):
+            b2[j] += cb[-1] * f
+            assert b2[j] < 2**31
+        return out, b2
+    out = jnp.concatenate([out, top[None]], axis=0)
+    b2.append(cb[-1])
+    return out, b2
 
 
-def _fold_high(x, bounds: Bounds):
-    """Fold limbs >= position 20 via 2^260 ≡ 16C (3-limb convolution)."""
-    n_hi = x.shape[-1] - NLIMB
+def _fold_high(x, bounds: Bounds) -> Tuple[jnp.ndarray, Bounds]:
+    """Fold limbs at positions >= NLIMB down via 2^260 ≡ 16C."""
+    n_hi = x.shape[0] - NLIMB
+    assert n_hi > 0
     out_len = max(NLIMB, n_hi + len(_FOLD260) - 1)
-    lo, hi = x[..., :NLIMB], x[..., NLIMB:]
+    lo, hi = x[:NLIMB], x[NLIMB:]
     pad = out_len - NLIMB
-    acc = jnp.concatenate([lo, jnp.zeros_like(x[..., :pad])], axis=-1) if pad else lo
+    acc = jnp.concatenate([lo, _zeros_rows(x, pad)], axis=0) if pad else lo
     b2 = bounds[:NLIMB] + [0] * pad
-    for j, c in enumerate(_FOLD260):
-        zl = jnp.zeros_like(x[..., :j])
-        zr = jnp.zeros_like(x[..., : out_len - j - n_hi])
-        acc = acc + jnp.concatenate([zl, hi * c, zr], axis=-1)
+    for j, f in enumerate(_FOLD260):
+        zl = _zeros_rows(x, j)
+        zr = _zeros_rows(x, out_len - j - n_hi)
+        acc = acc + jnp.concatenate([zl, hi * f, zr], axis=0)
         for i in range(n_hi):
-            b2[i + j] += bounds[NLIMB + i] * c
+            b2[i + j] += bounds[NLIMB + i] * f
+            assert b2[i + j] < 2**31
     return acc, b2
 
 
-_LOOSE = 1 << 15  # phase-A settling threshold; breaks the 2^13 carry fixpoint
+def _settled(bounds: Bounds) -> bool:
+    return len(bounds) == NLIMB and all(b <= w for b, w in zip(bounds, W2))
 
 
 def _settle(x, bounds: Bounds):
-    """Drive any nonnegative limb vector into weak 20-limb form.
+    """Drive any nonnegative limb vector into weak (W2-bounded) form.
 
-    All control flow depends only on the static bounds, so the op sequence is
-    fixed at trace time. Phase A (parallel passes + 16C folds) shrinks to 20
-    loosely-bounded limbs; phase B (short sequential chains) produces exact
-    13-bit limbs and folds bits >= 256, restoring the weak invariant.
+    Control flow depends only on the static bounds: the emitted op
+    sequence is fixed at trace time. Pure parallel passes + folds — no
+    sequential per-limb chains.
     """
-    # Phase A: parallel. Loose threshold avoids the fixpoint where an
-    # all-2^13 bound vector keeps regenerating a phantom carry column.
+    assert x.shape[0] == len(bounds)
     guard = 0
-    while x.shape[-1] > NLIMB or any(b > _LOOSE for b in bounds):
+    while not _settled(bounds):
         guard += 1
-        assert guard < 64, "settle failed to converge (static bounds bug)"
-        if any(b > _LOOSE for b in bounds):
-            x, bounds = _pass(x, bounds)
-        else:
+        assert guard < 24, "settle failed to converge (static bounds bug)"
+        if len(bounds) > NLIMB and all(
+            b * _FOLD260[0] < 2**30 for b in bounds[NLIMB:]
+        ):
             x, bounds = _fold_high(x, bounds)
-    # Phase B: one sequential exact carry over the 20 limbs (the only exact
-    # absorber the parallel bound domain cannot replace), then fold the two
-    # kinds of overflow — bits 256..259 of limb 19 via 2^256 ≡ C, and the
-    # carry past limb 19 via 2^260 ≡ 16C — and absorb with a 5-step chain.
-    # The top-fold runs *before* the carry-fold so the value stays < 3p
-    # (2^256 + 15C + c*16C) with no second wrap.
-    total = _total(bounds)
-    c_max = total >> (RADIX * NLIMB)  # bound on the carry past limb 19
-    assert c_max * 7440 < 2**31
-    cols = []
-    carry = None
-    for i in range(NLIMB):
-        v = x[..., i] if carry is None else x[..., i] + carry
-        cols.append(v & MASK)
-        carry = v >> RADIX
-    hi4 = cols[19] >> 9
-    cols[19] = cols[19] & 0x1FF
-    cols[0] = cols[0] + hi4 * 977
-    cols[2] = cols[2] + hi4 * 64
-    if c_max > 0:
-        for j, f in enumerate(_FOLD260):
-            cols[j] = cols[j] + carry * f
-    # Short chain: limbs 0..4; remaining carry <= 1 lands in limb 5, which
-    # stays <= 2^13 (the weak invariant allows it).
-    carry = None
-    for i in range(5):
-        v = cols[i] if carry is None else cols[i] + carry
-        cols[i] = v & MASK
-        carry = v >> RADIX
-    cols[5] = cols[5] + carry
-    return jnp.stack(cols, axis=-1)
+        else:
+            x, bounds = _pass(x, bounds)
+    return x
 
 
 def fe_add(a, b):
     """a + b mod p (weak in, weak out)."""
-    return _settle(a + b, [2 * w for w in _WEAK_BOUNDS])
+    return _settle(a + b, [2 * w for w in W2])
+
+
+_SUB_K = 32  # bias = 32p, encoded with every limb >= W2 (see below)
+
+
+def _sub_bias_limbs() -> np.ndarray:
+    """Encode 32p in 20 limbs with limb i >= W2[i], so a + bias - b is
+    nonnegative per limb for any weak a, b (bias value ≡ 0 mod p)."""
+    d = [int(v) for v in int_to_limbs(_SUB_K * P_INT, 21)]
+    # Merge the top limb down (32p < 2^261 so limb 20 is tiny).
+    d[19] += d[20] << RADIX
+    d = d[:20]
+    for i in range(NLIMB - 1):
+        while d[i] < W2[i]:
+            d[i] += 1 << RADIX
+            d[i + 1] -= 1
+    assert all(d[i] >= W2[i] for i in range(NLIMB)), d
+    assert all(d[i] + W2[i] < 2**31 for i in range(NLIMB))
+    assert sum(v << (RADIX * i) for i, v in enumerate(d)) == _SUB_K * P_INT
+    return np.asarray(d, dtype=np.int32)
+
+
+_SUB_BIAS = _sub_bias_limbs()
+_SUB_BOUNDS = [int(d) + w for d, w in zip(_SUB_BIAS, W2)]
 
 
 def fe_sub(a, b):
-    """a - b mod p (weak in/out): a + (32p in >=2^13-limb form) - b >= 0."""
-    bias = jnp.asarray(_SUB_BIAS)
-    pad = jnp.zeros_like(a[..., :1])
-    x = jnp.concatenate([a, pad], axis=-1) + bias - jnp.concatenate([b, pad], axis=-1)
-    bounds = [w + int(d) for w, d in zip(_WEAK_BOUNDS + [0], _SUB_BIAS)]
-    return _settle(x, bounds)
+    """a - b mod p (weak in/out): a + 32p(in >=W2-limb form) - b >= 0."""
+    bias = jnp.asarray(_SUB_BIAS).reshape((NLIMB,) + (1,) * (a.ndim - 1))
+    return _settle(a + bias - b, list(_SUB_BOUNDS))
 
 
 def fe_mul_small(a, k: int):
-    """a * k mod p for a small static k (k * 2^13 must fit int32)."""
-    assert 0 < k < 2**17
-    return _settle(a * k, [w * k for w in _WEAK_BOUNDS])
+    """a * k mod p for a small static k (k * W2[0] must fit int32)."""
+    assert 0 < k and k * W2[0] < 2**31
+    return _settle(a * k, [w * k for w in W2])
 
 
-def fe_mul(a, b):
-    """a * b mod p (weak in, weak out). ~400 int32 MACs/lane + carries."""
+def _conv_rows(a, b, bw: Bounds, aw: Bounds):
+    """Schoolbook convolution: out[k] = sum_{i+j=k} a[i]*b[j]."""
     out_len = 2 * NLIMB - 1
     acc = None
     bounds = [0] * out_len
     for i in range(NLIMB):
-        zl = jnp.zeros_like(a[..., :i])
-        zr = jnp.zeros_like(a[..., : out_len - i - NLIMB])
-        row = jnp.concatenate([zl, a[..., i : i + 1] * b, zr], axis=-1)
-        acc = row if acc is None else acc + row
+        row = a[i] * b  # (NLIMB, ...) scaled by one limb of a
+        padded = jnp.concatenate(
+            [_zeros_rows(b, i), row, _zeros_rows(b, out_len - i - NLIMB)],
+            axis=0,
+        )
+        acc = padded if acc is None else acc + padded
         for j in range(NLIMB):
-            bounds[i + j] += _WEAK_BOUNDS[i] * _WEAK_BOUNDS[j]
-    assert all(bv < 2**31 for bv in bounds)  # 20 * 2^26 < 2^31
+            bounds[i + j] += aw[i] * bw[j]
+    assert all(bv < 2**31 for bv in bounds)
+    return acc, bounds
+
+
+def fe_mul(a, b):
+    """a * b mod p (weak in, weak out). 400 int32 MACs/lane + parallel
+    carry passes — the per-lane unit the whole verify kernel reduces to."""
+    acc, bounds = _conv_rows(a, b, W2, W2)
     return _settle(acc, bounds)
 
 
 def fe_sqr(a):
-    """a^2 mod p."""
-    return fe_mul(a, a)
-
-
-def _exact_pass(x):
-    """Sequential exact carry: weak input -> exact 13-bit limbs, same value.
-
-    Weak values are < 2^260 so there is no carry out of limb 19.
-    """
-    cols = []
-    carry = None
+    """a^2 mod p: off-diagonal products shared (2*a_i*a_j), ~45% fewer
+    multiplies than fe_mul — doublings are squaring-heavy, this matters."""
+    out_len = 2 * NLIMB - 1
+    acc = None
+    bounds = [0] * out_len
+    a2 = a * 2
     for i in range(NLIMB):
-        v = x[..., i] if carry is None else x[..., i] + carry
-        cols.append(v & MASK)
-        carry = v >> RADIX
-    return jnp.stack(cols, axis=-1)
+        # diagonal a_i^2 once + doubled cross terms a_i * a_j (j > i).
+        hi = NLIMB - i - 1
+        row = jnp.concatenate(
+            [a[i : i + 1] * a[i : i + 1], a[i] * a2[i + 1 :]], axis=0
+        )
+        padded = jnp.concatenate(
+            [_zeros_rows(a, 2 * i), row, _zeros_rows(a, out_len - 2 * i - 1 - hi)],
+            axis=0,
+        )
+        acc = padded if acc is None else acc + padded
+        bounds[2 * i] += W2[i] * W2[i]
+        for j in range(i + 1, NLIMB):
+            bounds[i + j] += 2 * W2[i] * W2[j]
+    assert all(bv < 2**31 for bv in bounds)
+    return _settle(acc, bounds)
 
 
-def _cond_sub_p(x):
-    """One conditional subtract-p on exact-13-bit-limbed x."""
-    p = jnp.asarray(_P_LIMBS)
-    d = x - p
-    cols = []
-    borrow = None
-    for i in range(NLIMB):
-        v = d[..., i] if borrow is None else d[..., i] + borrow
-        cols.append(v & MASK)
-        borrow = v >> RADIX  # 0 or -1 (arithmetic shift)
-    ge = borrow == 0  # no net borrow -> x >= p
-    sub = jnp.stack(cols, axis=-1)
-    return jnp.where(ge[..., None], sub, x)
+# ---------------------------------------------------------------------------
+# Exactness: Kogge-Stone carry lookahead (all whole-array ops).
+
+_KS_MAX = (1 << (RADIX + 1)) - 2  # per-limb cap for single-bit carries
 
 
-def fe_canon(a):
-    """Weak -> canonical representative in [0, p), exact 13-bit limbs.
+def _ks_exact(x):
+    """Exact carry propagation for limbs <= _KS_MAX: returns (exact 13-bit
+    limbs, carry-out of limb 19 in {0,1}). Kogge-Stone over the limb axis:
+    g=generate, pr=propagate, log2(20)=5 combine steps."""
+    g = (x > MASK).astype(jnp.int32)
+    pr = (x == MASK).astype(jnp.int32)
+    d = 1
+    while d < NLIMB:
+        gs = jnp.concatenate([_zeros_rows(g, d), g[:-d]], axis=0)
+        ps = jnp.concatenate([_zeros_rows(pr, d), pr[:-d]], axis=0)
+        g = g | (pr & gs)
+        pr = pr & ps
+        d *= 2
+    cin = jnp.concatenate([_zeros_rows(g, 1), g[:-1]], axis=0)
+    exact = (x + cin) & MASK
+    return exact, g[NLIMB - 1]
 
-    Weak values are < 3p, so two conditional subtractions suffice.
+
+def _exact_lt_2p(x, bounds: Bounds):
+    """Weak-ish x -> exact 13-bit limbs of a value v ≡ x (mod p), v < 2p.
+
+    Steps: settle into KS range -> KS (value < 2^261 so carry-out <= 1)
+    -> fold carry-out and bits 256..259 via C multiples -> second KS.
     """
-    x = _exact_pass(a)
-    x = _cond_sub_p(x)
-    return _cond_sub_p(x)
-
-
-_2P_LIMBS = int_to_limbs(2 * P_INT)
-
-
-def _is_zero_exact(z):
-    """Exact-13-bit-limbed z (value < 3p): is z ≡ 0 mod p?
-
-    The exact representation is unique per value, so z ≡ 0 iff its limbs
-    match 0, p, or 2p — no conditional subtractions needed.
-    """
-    p1 = jnp.asarray(_P_LIMBS)
-    p2 = jnp.asarray(_2P_LIMBS)
-    return (
-        jnp.all(z == 0, axis=-1)
-        | jnp.all(z == p1, axis=-1)
-        | jnp.all(z == p2, axis=-1)
+    while len(bounds) > NLIMB or any(b > _KS_MAX for b in bounds):
+        if len(bounds) > NLIMB:
+            x, bounds = _fold_high(x, bounds)
+        else:
+            x, bounds = _pass(x, bounds)
+    assert sum(b << (RADIX * i) for i, b in enumerate(bounds)) < 2**261
+    e, cout = _ks_exact(x)
+    # v1 = e + cout*2^260; fold cout*2^260 ≡ cout*16C and the top 4 bits
+    # of limb 19 (2^256..2^259) ≡ hi4*C = hi4*(977 + 64*2^26).
+    hi4 = e[NLIMB - 1] >> 9
+    top = e[NLIMB - 1] & 0x1FF
+    f0 = e[0] + cout * _FOLD260[0] + hi4 * 977
+    f1 = e[1] + cout * _FOLD260[1]
+    f2 = e[2] + cout * _FOLD260[2] + hi4 * 64
+    # f0 <= MASK+7440+14655, beyond the single-bit-carry KS range: absorb
+    # its carry into f1 locally (one shift+add, still fully parallel).
+    f1 = f1 + (f0 >> RADIX)
+    f0 = f0 & MASK
+    x2 = jnp.concatenate(
+        [jnp.stack([f0, f1, f2], axis=0), e[3 : NLIMB - 1], top[None]], axis=0
     )
+    # Bounds after absorb: f0<=MASK, f1<=MASK+1+3, f2<=MASK+1024+960.
+    assert MASK + _FOLD260[1] + (MASK + _FOLD260[0] + 15 * 977) // (MASK + 1) <= _KS_MAX
+    assert MASK + _FOLD260[2] + 15 * 64 <= _KS_MAX
+    e2, cout2 = _ks_exact(x2)
+    # v2 = (e - hi4*2^256) + hi4*C + cout*16C < 2^256 + 31C < 2p, and
+    # < 2^260, so cout2 is structurally 0; e2 is exact.
+    del cout2
+    return e2
 
 
-def fe_is_zero(a):
-    """a ≡ 0 mod p? Returns (...,) bool."""
-    return _is_zero_exact(_exact_pass(a))
+def fe_canon(a, bounds: Bounds = None):
+    """Weak -> canonical representative in [0, p), exact 13-bit limbs."""
+    e = _exact_lt_2p(a, list(W2) if bounds is None else list(bounds))
+    # One conditional subtract-p via borrow lookahead: d = e - p limbwise;
+    # borrow-in b satisfies the same prefix recurrence with
+    # g = (d < 0), pr = (d == 0) on the negated difference domain.
+    p = jnp.asarray(_P_LIMBS).reshape((NLIMB,) + (1,) * (a.ndim - 1))
+    d = e - p
+    g = (d < 0).astype(jnp.int32)
+    pr = (d == 0).astype(jnp.int32)  # zero diff propagates an incoming borrow
+    dd = 1
+    gg, pp = g, pr
+    while dd < NLIMB:
+        gs = jnp.concatenate([_zeros_rows(gg, dd), gg[:-dd]], axis=0)
+        ps = jnp.concatenate([_zeros_rows(pp, dd), pp[:-dd]], axis=0)
+        gg = gg | (pp & gs)
+        pp = pp & ps
+        dd *= 2
+    bin_ = jnp.concatenate([_zeros_rows(gg, 1), gg[:-1]], axis=0)
+    sub = (d - bin_) & MASK
+    ge = gg[NLIMB - 1] == 0  # no net borrow -> e >= p
+    return jnp.where(ge[None], sub, e)
 
 
-def fe_is_zero_pair(u, v):
-    """(u ≡ 0, v ≡ 0) sharing one carry chain (group-op hot path)."""
-    z = _is_zero_exact(_exact_pass(jnp.stack([u, v], axis=0)))
-    return z[0], z[1]
+def fe_is_zero(a, bounds: Bounds = None):
+    """a ≡ 0 mod p? Returns (...,) bool (batch shape without limb axis)."""
+    e = _exact_lt_2p(a, list(W2) if bounds is None else list(bounds))
+    p = jnp.asarray(_P_LIMBS).reshape((NLIMB,) + (1,) * (a.ndim - 1))
+    return jnp.all(e == 0, axis=0) | jnp.all(e == p, axis=0)
 
 
-def fe_is_zero_many(vals):
-    """Zero tests for a sequence of elements, one shared carry chain."""
-    z = _is_zero_exact(_exact_pass(jnp.stack(list(vals), axis=0)))
-    return tuple(z[i] for i in range(len(vals)))
+def fe_is_zero_many(vals: Sequence):
+    """Zero tests for k same-shape elements via one widened dispatch: the
+    operands are concatenated along the lane axis so the lookahead runs
+    once at k-fold width (cheaper than k narrow chains)."""
+    k = len(vals)
+    cat = jnp.concatenate(list(vals), axis=-1)
+    z = fe_is_zero(cat)
+    n = z.shape[-1] // k
+    return tuple(z[..., i * n : (i + 1) * n] for i in range(k))
 
 
 def fe_eq(a, b):
     """a ≡ b mod p? (weak inputs)"""
-    return jnp.all(fe_canon(a) == fe_canon(b), axis=-1)
+    return fe_is_zero(fe_sub(a, b))
 
 
 def fe_pow_const(a, e: int):
-    """a^e mod p for a static exponent (square-and-multiply under lax.scan;
-    the schedule is fixed at trace time and the graph stays tiny)."""
+    """a^e mod p for a static exponent (square-and-multiply under
+    lax.scan; schedule fixed at trace time, graph stays tiny)."""
     from jax import lax
 
     bits = jnp.asarray([int(c) for c in bin(e)[2:]], dtype=jnp.int32)
@@ -352,20 +457,34 @@ def fe_inv(a):
     return fe_pow_const(a, P_INT - 2)
 
 
+def fe_batch_inv(a, zero_mask):
+    """Per-lane inverse over a (20, B) batch via Montgomery's trick.
+
+    Two associative scans of fe_mul along the batch axis (prefix and
+    suffix products) plus ONE tiny Fermat inversion of the grand product:
+    ~4 field muls per lane instead of ~500 (`inv_i = pre_{i-1} * suf_{i+1}
+    * inv(total)`). This is the batch-axis analogue of the reference's
+    batch-inverse pattern — the lanes already advance in lockstep, so the
+    scan tree is log-depth whole-array work.
+
+    `zero_mask` (B,) marks lanes whose input is ≡ 0 (they would zero the
+    whole product); such lanes contribute 1 to the scans and return 0,
+    preserving the fe_inv(0) = 0 convention.
+    """
+    from jax import lax
+
+    one = jnp.zeros_like(a).at[0].set(1)
+    aa = jnp.where(zero_mask[None], one, a)
+    pre = lax.associative_scan(fe_mul, aa, axis=1)
+    suf = jnp.flip(lax.associative_scan(fe_mul, jnp.flip(aa, 1), axis=1), 1)
+    tinv = fe_inv(pre[:, -1:])  # (20, 1): one narrow Fermat chain
+    left = jnp.concatenate([one[:, :1], pre[:, :-1]], axis=1)
+    right = jnp.concatenate([suf[:, 1:], one[:, :1]], axis=1)
+    out = fe_mul(fe_mul(left, right), jnp.broadcast_to(tinv, a.shape))
+    return jnp.where(zero_mask[None], jnp.zeros_like(a), out)
+
+
 def fe_sqrt(a):
     """Candidate square root a^((p+1)/4) (p ≡ 3 mod 4). The caller must
     check candidate^2 == a; for non-residues the candidate is garbage."""
     return fe_pow_const(a, (P_INT + 1) // 4)
-
-
-def ints_to_limbs_batch(vals) -> np.ndarray:
-    """Vectorized host packing: list of ints (< 2^257) -> (n, 20) int32."""
-    raw = b"".join(v.to_bytes(33, "little") for v in vals)
-    nb = np.frombuffer(raw, dtype=np.uint8).reshape(-1, 33).astype(np.int64)
-    limbs = np.empty((len(vals), NLIMB), dtype=np.int32)
-    for i in range(NLIMB):
-        bitpos = RADIX * i
-        k, sh = bitpos >> 3, bitpos & 7
-        window = nb[:, k] | (nb[:, k + 1] << 8) | (nb[:, k + 2] << 16)
-        limbs[:, i] = (window >> sh) & MASK
-    return limbs

@@ -47,35 +47,35 @@ def make_mesh(n_devices: Optional[int] = None, axis: str = "batch") -> Mesh:
 def make_sharded_step(mesh: Mesh):
     """The full multichip verify step, jitted over `mesh`.
 
-    Returns ``step(a, b, px, py, t1, t2, parity, valid) -> (per_lane, all_ok)``
-    where inputs are batch-sharded, `per_lane` comes back batch-sharded, and
-    `all_ok` is a replicated scalar produced by a psum AND-reduction inside
-    shard_map (the cross-chip collective).
+    Returns ``step(a, b, px, py, want_odd, t1, t2, parity, valid, live)
+    -> (per_lane, all_ok)`` where inputs are batch-sharded, `per_lane`
+    comes back batch-sharded, and `all_ok` is a replicated scalar produced
+    by a psum AND-reduction inside shard_map (the cross-chip collective —
+    the `CCheckQueueControl::Wait` analogue, checkqueue.h:139-142).
+    `live` marks real lanes: padding added to reach the batch shape is not
+    counted as a failure, while structurally-invalid real lanes are.
     """
     axis = mesh.axis_names[0]
-    lane_sharding = NamedSharding(mesh, P(axis, None))
+    fields_sharding = NamedSharding(mesh, P(axis, None, None))
     flat_sharding = NamedSharding(mesh, P(axis))
     replicated = NamedSharding(mesh, P())
 
-    def reduce_all(ok_local):
-        # ok_local: this shard's verdicts. all-valid <=> no failures anywhere.
-        failures = jnp.sum(jnp.where(ok_local, 0, 1))
+    def reduce_all(ok_local, live_local):
+        # all-valid <=> no live lane failed, on any shard.
+        failures = jnp.sum(jnp.where(live_local & ~ok_local, 1, 0))
         return jax.lax.psum(failures, axis) == 0
 
     reduce_sharded = shard_map(
-        reduce_all, mesh=mesh, in_specs=P(axis), out_specs=P()
+        reduce_all, mesh=mesh, in_specs=(P(axis), P(axis)), out_specs=P()
     )
 
-    def step(a, b, px, py, want_odd, t1, t2, parity, valid):
-        per_lane = _verify_kernel(a, b, px, py, want_odd, t1, t2, parity, valid)
-        return per_lane, reduce_sharded(per_lane)
+    def step(fields, want_odd, parity_req, has_t2, valid, live):
+        per_lane = _verify_kernel(fields, want_odd, parity_req, has_t2, valid)
+        return per_lane, reduce_sharded(per_lane, live)
 
     return jax.jit(
         step,
-        in_shardings=(lane_sharding,) * 4
-        + (flat_sharding,)
-        + (lane_sharding,) * 2
-        + (flat_sharding, flat_sharding),
+        in_shardings=(fields_sharding,) + (flat_sharding,) * 5,
         out_shardings=(flat_sharding, replicated),
     )
 
@@ -84,17 +84,36 @@ class ShardedSecpVerifier(TpuSecpVerifier):
     """Drop-in TpuSecpVerifier that spreads each dispatch over a mesh."""
 
     def __init__(self, mesh: Optional[Mesh] = None, min_batch: int = 8,
-                 max_batch: int = 1 << 16):
-        super().__init__(min_batch=min_batch, max_batch=max_batch)
+                 chunk: int = 1 << 13):
+        super().__init__(min_batch=min_batch, chunk=chunk)
         self.mesh = mesh if mesh is not None else make_mesh()
         n = self.mesh.devices.size
-        # Batch sizes must divide evenly across the mesh.
-        while self._min_batch % n:
-            self._min_batch *= 2
+        # Batch sizes must divide evenly across the mesh: round min_batch up
+        # to a multiple of n (doubling in _pad preserves divisibility).
+        self._min_batch = -(-self._min_batch // n) * n
         self._step = make_sharded_step(self.mesh)
-        self._kernel = lambda *args: self._step(*args)[0]
+        self._verdict_acc = True
+        self._dispatched = 0
+
+    def _run_kernel(self, args, n: int) -> np.ndarray:
+        live = np.zeros(args[-1].shape[0], dtype=bool)
+        live[:n] = True
+        per_lane, all_ok = self._step(*args, live)
+        self._verdict_acc = self._verdict_acc and bool(all_ok)
+        self._dispatched += n
+        return per_lane
 
     def verify_checks_with_verdict(self, checks: Sequence[SigCheck]):
-        """(per-check results, block-level all-ok) in one sharded dispatch."""
+        """(per-check results, block-level all-ok).
+
+        The all-ok verdict of device-dispatched lanes comes from the psum
+        AND-reduction inside the sharded step (the collective barrier), not
+        a host re-reduction; lanes rejected host-side before dispatch
+        (structural parse failures) AND into the verdict via the dispatched
+        count.
+        """
+        self._verdict_acc = True
+        self._dispatched = 0
         res = self.verify_checks(checks)
-        return res, bool(res.all())
+        verdict = self._verdict_acc and self._dispatched == len(checks)
+        return res, verdict

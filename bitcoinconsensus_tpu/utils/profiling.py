@@ -1,0 +1,60 @@
+"""Per-phase wall-clock timers for the verify pipeline.
+
+The reference ships no tracing at all (SURVEY §5); its bench layer is
+nanobench harnesses. Our pipeline crosses a host→device boundary, so the
+first profiling question is always attribution: host parse vs limb pack vs
+device dispatch vs readback. A `Phases` object accumulates seconds per
+named phase across calls; `TpuSecpVerifier` keeps one (see
+`crypto/jax_backend.py`) and `report()` summarises it.
+
+Usage:
+    ph = Phases()
+    with ph("prep"):
+        ...
+    ph.report()  # {"prep": {"secs": ..., "calls": ...}, ...}
+
+Timers are cheap (two perf_counter calls) but not free; they are on by
+default because one batch is thousands of signatures — the per-batch
+overhead is noise. `Phases(enabled=False)` turns them into no-ops.
+"""
+
+from __future__ import annotations
+
+import time
+from contextlib import contextmanager
+from typing import Dict
+
+__all__ = ["Phases"]
+
+
+class Phases:
+    def __init__(self, enabled: bool = True):
+        self.enabled = enabled
+        self._secs: Dict[str, float] = {}
+        self._calls: Dict[str, int] = {}
+
+    @contextmanager
+    def __call__(self, name: str):
+        if not self.enabled:
+            yield
+            return
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            dt = time.perf_counter() - t0
+            self._secs[name] = self._secs.get(name, 0.0) + dt
+            self._calls[name] = self._calls.get(name, 0) + 1
+
+    def reset(self) -> None:
+        self._secs.clear()
+        self._calls.clear()
+
+    def report(self) -> Dict[str, Dict[str, float]]:
+        return {
+            k: {"secs": round(self._secs[k], 6), "calls": self._calls[k]}
+            for k in self._secs
+        }
+
+    def total(self) -> float:
+        return sum(self._secs.values())

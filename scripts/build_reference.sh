@@ -1,0 +1,48 @@
+#!/bin/sh
+# Build the reference consensus library (Bitcoin Core 0.21 subset + vendored
+# libsecp256k1) as a shared object, for two purposes only:
+#   1. measuring the CPU baseline BASELINE.md mandates ("the CPU baseline
+#      must be measured, not quoted"), and
+#   2. differential fuzzing of the new engine against the reference
+#      (`script_tests.cpp:22-24` consensus-lib round-trip precedent).
+#
+# Sources are read from the read-only reference checkout; nothing is copied
+# into the repo. Artifacts land in the gitignored .baseline/ dir. The
+# compile recipe mirrors /root/reference/build.rs:36-96 (same defines, same
+# file list, 64-bit path).
+set -e
+
+REF="${BITCOIN_REFERENCE_ROOT:-/root/reference}/depend/bitcoin/src"
+OUT="$(dirname "$0")/../.baseline"
+mkdir -p "$OUT"
+
+if [ -f "$OUT/libbitcoinconsensus.so" ] && [ -z "$FORCE" ]; then
+    echo "already built: $OUT/libbitcoinconsensus.so (FORCE=1 to rebuild)"
+    exit 0
+fi
+
+SECP_DEFS="-DSECP256K1_BUILD=1 -DUSE_NUM_NONE=1 -DUSE_FIELD_INV_BUILTIN=1 \
+ -DUSE_SCALAR_INV_BUILTIN=1 -DENABLE_MODULE_RECOVERY=1 -DECMULT_WINDOW_SIZE=15 \
+ -DECMULT_GEN_PREC_BITS=4 -DENABLE_MODULE_SCHNORRSIG=1 -DENABLE_MODULE_EXTRAKEYS=1 \
+ -DUSE_FIELD_5X52=1 -DUSE_SCALAR_4X64=1 -DHAVE___INT128=1"
+
+gcc -O2 -fPIC -c $SECP_DEFS \
+    -I"$REF/secp256k1" -I"$REF/secp256k1/src" -Wno-unused-function \
+    "$REF/secp256k1/src/secp256k1.c" -o "$OUT/secp256k1.o"
+
+CXXFILES="util/strencodings.cpp uint256.cpp pubkey.cpp hash.cpp \
+ primitives/transaction.cpp crypto/ripemd160.cpp crypto/sha1.cpp \
+ crypto/sha256.cpp crypto/sha512.cpp crypto/hmac_sha512.cpp \
+ script/bitcoinconsensus.cpp script/interpreter.cpp script/script.cpp \
+ script/script_error.cpp"
+
+OBJS="$OUT/secp256k1.o"
+for f in $CXXFILES; do
+    o="$OUT/$(echo "$f" | tr '/' '_' | sed 's/\.cpp$/.o/')"
+    g++ -O2 -fPIC -std=c++17 -c -I"$REF" -I"$REF/secp256k1/include" \
+        -Wno-unused-parameter "$REF/$f" -o "$o"
+    OBJS="$OBJS $o"
+done
+
+g++ -shared -o "$OUT/libbitcoinconsensus.so" $OBJS
+echo "built $OUT/libbitcoinconsensus.so"

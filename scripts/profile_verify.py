@@ -1,0 +1,62 @@
+"""Attribute verify-pipeline time: host prep vs pack vs device vs readback.
+
+Run on the real chip (no args) or CPU (JAX_PLATFORMS=cpu). All-unique
+signatures — no in-batch dedup flattery. Prints per-phase seconds for a
+BATCH-lane mixed dispatch plus a device-only kernel timing.
+"""
+
+import hashlib
+import json
+import sys
+import time
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+BATCH = int(sys.argv[1]) if len(sys.argv) > 1 else 8192
+
+
+def main():
+    from bitcoinconsensus_tpu.crypto import secp_host as H
+    from bitcoinconsensus_tpu.crypto.jax_backend import SigCheck, TpuSecpVerifier
+
+    t0 = time.time()
+    checks = []
+    for i in range(BATCH):
+        sk = (i * 2654435761 + 424242) % (H.N - 1) + 1
+        msg = hashlib.sha256(b"prof-%d" % i).digest()
+        if i % 3 == 2:
+            xpk, _ = H.xonly_pubkey_create(sk)
+            checks.append(SigCheck("schnorr", (xpk, H.sign_schnorr(sk, msg), msg)))
+        else:
+            pub = H.pubkey_create(sk, compressed=bool(i % 2))
+            checks.append(SigCheck("ecdsa", (pub, H.sign_ecdsa(sk, msg), msg)))
+    print(f"built {BATCH} unique checks in {time.time()-t0:.1f}s", file=sys.stderr)
+
+    verifier = TpuSecpVerifier()
+    t0 = time.time()
+    res = verifier.verify_checks(checks)  # compile + warmup
+    print(f"warmup (incl. compile): {time.time()-t0:.1f}s", file=sys.stderr)
+    assert res.all()
+
+    best = None
+    for _ in range(3):
+        verifier.phases.reset()
+        t0 = time.time()
+        res = verifier.verify_checks(checks)
+        dt = time.time() - t0
+        rep = verifier.phases.report()
+        if best is None or dt < best[0]:
+            best = (dt, rep)
+    assert res.all()
+
+    dt, rep = best
+    print(json.dumps({
+        "batch": BATCH,
+        "total_secs": round(dt, 4),
+        "verifies_per_sec": round(BATCH / dt, 1),
+        "phases": rep,
+    }, indent=2))
+
+
+if __name__ == "__main__":
+    main()

@@ -7,12 +7,33 @@ separately dry-runs the multichip path via __graft_entry__.dryrun_multichip).
 import os
 import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Force CPU even when the ambient environment points JAX at an accelerator
+# (e.g. JAX_PLATFORMS=axon): the suite validates consensus + sharding logic
+# on an 8-device virtual mesh, never on real hardware. Some accelerator
+# plugins override the JAX_PLATFORMS env var at import time, so the explicit
+# config.update below (before any backend initializes) is load-bearing.
+os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+# Persistent compile cache (same dir the backend configures): the windowed
+# verify kernel is the dominant compile; caching it across test processes
+# keeps suite runtime sane.
+jax.config.update(
+    "jax_compilation_cache_dir",
+    os.environ.get(
+        "BITCOINCONSENSUS_TPU_CACHE",
+        os.path.expanduser("~/.cache/bitcoinconsensus_tpu_xla"),
+    ),
+)
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 

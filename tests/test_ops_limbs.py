@@ -1,0 +1,207 @@
+"""Kernel tests: batched field arithmetic (`ops/limbs.py`) vs Python ints.
+
+The reference's pattern is randomized KATs plus exhaustive coverage of the
+arithmetic edge space (`secp256k1/src/tests.c`, `tests_exhaustive.c`). Here
+every public fe_* op is driven over a single batch containing random
+operands AND the boundary values of the weak representation (0, 1, p-1, p,
+p+1, 2p, values with limbs at the W2 bounds), checked bit-for-bit against
+plain Python integer arithmetic mod p. Layout is limb-major: (20, B).
+"""
+
+import random
+
+import numpy as np
+
+from conftest import *  # noqa: F401,F403 (pins CPU platform before jax import)
+
+import jax
+
+from bitcoinconsensus_tpu.ops.limbs import (
+    MASK,
+    NLIMB,
+    P_INT,
+    W2,
+    fe_add,
+    fe_canon,
+    fe_eq,
+    fe_inv,
+    fe_is_zero,
+    fe_is_zero_many,
+    fe_mul,
+    fe_mul_small,
+    fe_sqr,
+    fe_sqrt,
+    fe_sub,
+    int_to_limbs,
+    ints_to_limbs_batch,
+    limbs_to_int,
+)
+
+RNG = random.Random(0xC0FFEE)
+
+
+def _edge_values():
+    return [0, 1, 2, P_INT - 1, P_INT, P_INT + 1, 2 * P_INT]
+
+
+def _edge_limb_cols():
+    """Weak limb vectors at the W2 bounds (int_to_limbs never makes these)."""
+    cols = [np.asarray(W2, dtype=np.int32)]
+    col = np.zeros(NLIMB, dtype=np.int32)
+    col[0] = W2[0]  # value > 2^13 carried entirely in limb 0
+    cols.append(col)
+    col2 = np.zeros(NLIMB, dtype=np.int32)
+    col2[NLIMB - 1] = W2[NLIMB - 1]  # top limb at bound (value past 2^260)
+    cols.append(col2)
+    return cols
+
+
+def _batch(values, extra_cols=()):
+    cols = [int_to_limbs(v) for v in values] + list(extra_cols)
+    return np.stack(cols, axis=-1).astype(np.int32)
+
+
+def _to_ints(arr):
+    arr = np.asarray(arr)
+    return [limbs_to_int(arr[:, i]) for i in range(arr.shape[1])]
+
+
+def test_weak_invariant_of_all_ops():
+    """Every op's output must satisfy the W2 weak invariant it claims."""
+    vals = _edge_values() + [RNG.randrange(3 * P_INT) for _ in range(21)]
+    a = _batch(vals, _edge_limb_cols())
+    b = _batch(list(reversed(vals)), _edge_limb_cols())
+
+    for out in (
+        jax.jit(fe_add)(a, b),
+        jax.jit(fe_sub)(a, b),
+        jax.jit(fe_mul)(a, b),
+        jax.jit(fe_sqr)(a),
+        jax.jit(lambda x: fe_mul_small(x, 8))(a),
+    ):
+        out = np.asarray(out)
+        assert out.min() >= 0
+        for i in range(NLIMB):
+            assert out[i].max() <= W2[i], f"limb {i} exceeds W2"
+
+
+def test_add_sub_mul_vs_python():
+    vals = _edge_values() + [RNG.randrange(3 * P_INT) for _ in range(21)]
+    a = _batch(vals, _edge_limb_cols())
+    b = _batch(list(reversed(vals)), _edge_limb_cols())
+    ia, ib = _to_ints(a), _to_ints(b)
+
+    got = _to_ints(jax.jit(fe_add)(a, b))
+    for x, y, g in zip(ia, ib, got):
+        assert g % P_INT == (x + y) % P_INT
+
+    got = _to_ints(jax.jit(fe_sub)(a, b))
+    for x, y, g in zip(ia, ib, got):
+        assert g % P_INT == (x - y) % P_INT
+
+    got = _to_ints(jax.jit(fe_mul)(a, b))
+    for x, y, g in zip(ia, ib, got):
+        assert g % P_INT == (x * y) % P_INT
+
+    got = _to_ints(jax.jit(fe_sqr)(a))
+    for x, g in zip(ia, got):
+        assert g % P_INT == (x * x) % P_INT
+
+    for k in (1, 2, 3, 8, 977, 2**17):
+        got = _to_ints(jax.jit(lambda x, k=k: fe_mul_small(x, k))(a))
+        for x, g in zip(ia, got):
+            assert g % P_INT == (x * k) % P_INT
+
+
+def test_canon_and_eq():
+    vals = _edge_values() + [RNG.randrange(3 * P_INT) for _ in range(13)]
+    a = _batch(vals, _edge_limb_cols())
+    ia = _to_ints(a)
+    got = np.asarray(jax.jit(fe_canon)(a))
+    for i, x in enumerate(ia):
+        assert limbs_to_int(got[:, i]) == x % P_INT  # unique rep in [0, p)
+        assert got[:, i].max() <= MASK
+
+    # fe_eq across different weak representatives of the same residue.
+    reps = _batch([5, 5 + P_INT, 5 + 2 * P_INT, 6, P_INT, 0])
+    eq = np.asarray(jax.jit(fe_eq)(reps[:, :3], reps[:, [1, 2, 0]]))
+    assert eq.all()
+    assert not np.asarray(jax.jit(fe_eq)(reps[:, 3:4], reps[:, 0:1]))[0]
+    assert np.asarray(jax.jit(fe_eq)(reps[:, 4:5], reps[:, 5:6]))[0]  # p ≡ 0
+
+
+def test_is_zero():
+    vals = [0, P_INT, 2 * P_INT, 1, P_INT - 1, P_INT + 1, 3 * P_INT - 1]
+    a = _batch(vals)
+    got = np.asarray(jax.jit(fe_is_zero)(a))
+    assert list(got) == [True, True, True, False, False, False, False]
+    # Weak zero produced by arithmetic (x - x) must read as zero; W2-bound
+    # columns with value ≡ 0 don't exist, but x-x exercises bias residue.
+    x = _batch([RNG.randrange(P_INT) for _ in range(4)])
+    z = jax.jit(fe_sub)(x, x)
+    assert np.asarray(jax.jit(fe_is_zero)(z)).all()
+
+    many = jax.jit(lambda u, v, w: fe_is_zero_many((u, v, w)))(
+        _batch([0, 5]), _batch([P_INT, 7]), _batch([3, 2 * P_INT])
+    )
+    assert [list(np.asarray(m)) for m in many] == [
+        [True, False], [True, False], [False, True]]
+
+
+def test_inv_and_sqrt():
+    vals = [1, 2, P_INT - 1, 0x7FFF] + [RNG.randrange(1, P_INT) for _ in range(8)]
+    a = _batch(vals)
+    inv = _to_ints(jax.jit(fe_inv)(a))
+    for x, g in zip(vals, inv):
+        assert (x * g) % P_INT == 1
+    # 0 -> 0 (Fermat inverse convention the group code relies on).
+    z = np.asarray(jax.jit(fe_inv)(_batch([0, P_INT])))
+    assert all(v % P_INT == 0 for v in _to_ints(z))
+
+    # sqrt: squares round-trip; non-residues produce a candidate whose
+    # square differs (callers must check — mirror that check here).
+    squares = [(v * v) % P_INT for v in vals]
+    s = _batch(squares)
+    cand = _to_ints(jax.jit(fe_sqrt)(s))
+    for sq, c in zip(squares, cand):
+        assert (c * c) % P_INT == sq
+    nonres = []
+    while len(nonres) < 4:
+        v = RNG.randrange(1, P_INT)
+        if pow(v, (P_INT - 1) // 2, P_INT) == P_INT - 1:
+            nonres.append(v)
+    cand = _to_ints(jax.jit(fe_sqrt)(_batch(nonres)))
+    for v, c in zip(nonres, cand):
+        assert (c * c) % P_INT != v % P_INT
+
+
+def test_ints_to_limbs_batch_matches_scalar():
+    vals = [0, 1, P_INT - 1, P_INT, 2**257 - 1] + [
+        RNG.randrange(2**257) for _ in range(16)
+    ]
+    got = ints_to_limbs_batch(vals)  # (n, 20) row-major host layout
+    want = np.stack([int_to_limbs(v) for v in vals])
+    assert np.array_equal(got, want)
+
+
+def test_mul_chain_stress():
+    """Long dependent chains (the shape of the real kernel) stay exact."""
+    x = RNG.randrange(P_INT)
+    a = _batch([x])
+    want = x
+
+    @jax.jit
+    def chain(a):
+        three = _batch([3])
+        for _ in range(20):
+            a = fe_mul(a, a)
+            a = fe_add(a, a)
+            a = fe_sub(a, three)
+        return a
+
+    got = _to_ints(chain(a))[0]
+    for _ in range(20):
+        want = want * want % P_INT
+        want = want * 2 % P_INT
+        want = (want - 3) % P_INT
+    assert got % P_INT == want

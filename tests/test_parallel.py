@@ -45,3 +45,49 @@ def test_sharded_matches_unsharded():
     assert np.array_equal(plain, res)
     assert not all_ok  # lanes 4 and 5 are corrupted
     assert list(np.nonzero(~res)[0]) == [4, 5]
+
+    good = [c for i, c in enumerate(checks) if i not in (4, 5)]
+    res2, ok2 = sharded.verify_checks_with_verdict(good)
+    assert res2.all() and ok2  # collective verdict from the psum step
+
+
+def test_sharded_non_power_of_two_mesh():
+    """A 6-device mesh must not hang (ADVICE r1 medium) and must agree."""
+    import hashlib
+
+    from bitcoinconsensus_tpu.crypto import secp_host as H
+    from bitcoinconsensus_tpu.crypto.jax_backend import SigCheck, TpuSecpVerifier
+    from bitcoinconsensus_tpu.parallel.mesh import ShardedSecpVerifier, make_mesh
+
+    checks = []
+    for i in range(5):
+        sk = (i * 104729 + 11) % (H.N - 1) + 1
+        msg = hashlib.sha256(b"np2-%d" % i).digest()
+        checks.append(SigCheck("ecdsa", (H.pubkey_create(sk), H.sign_ecdsa(sk, msg), msg)))
+
+    sharded = ShardedSecpVerifier(make_mesh(6))
+    assert sharded._min_batch % 6 == 0
+    res, all_ok = sharded.verify_checks_with_verdict(checks)
+    assert res.all() and all_ok
+    plain = TpuSecpVerifier().verify_checks(checks)
+    assert np.array_equal(plain, res)
+
+
+def test_sharded_verdict_counts_host_rejected_lane():
+    """A lane that fails host-side structural parsing (never dispatched)
+    must still flip the block verdict to False."""
+    import hashlib
+
+    from bitcoinconsensus_tpu.crypto import secp_host as H
+    from bitcoinconsensus_tpu.crypto.jax_backend import SigCheck
+    from bitcoinconsensus_tpu.parallel.mesh import ShardedSecpVerifier, make_mesh
+
+    sk = 12345
+    msg = hashlib.sha256(b"hr").digest()
+    checks = [
+        SigCheck("ecdsa", (H.pubkey_create(sk), H.sign_ecdsa(sk, msg), msg)),
+        SigCheck("ecdsa", (b"\x02" + b"\x00" * 31, b"junk-not-der", msg)),
+    ]
+    res, all_ok = ShardedSecpVerifier(make_mesh(8)).verify_checks_with_verdict(checks)
+    assert list(res) == [True, False]
+    assert not all_ok
