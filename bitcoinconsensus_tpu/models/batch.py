@@ -43,6 +43,12 @@ from ..core.serialize import SerializationError
 from ..core.sighash import PrecomputedTxData
 from ..core.tx import Tx, TxOut
 from ..crypto.jax_backend import SigCheck, TpuSecpVerifier, default_verifier
+from .sigcache import (
+    ScriptExecutionCache,
+    SigCache,
+    default_script_cache,
+    default_sig_cache,
+)
 
 __all__ = ["BatchItem", "BatchResult", "verify_batch"]
 
@@ -156,17 +162,47 @@ def _prepare(item: BatchItem, tx_cache: Dict[bytes, Tx]) -> _Prepared:
 def verify_batch(
     items: Sequence[BatchItem],
     verifier: Optional[TpuSecpVerifier] = None,
+    sig_cache: Optional[SigCache] = None,
+    script_cache: Optional[ScriptExecutionCache] = None,
 ) -> List[BatchResult]:
     """Verify many inputs with one TPU signature dispatch.
 
     Returns one `BatchResult` per item, bit-identical to the per-input API.
+    The cross-batch caches (success-only, salted keys — the
+    `script/sigcache.cpp` / `validation.cpp:1529-1536` production skip
+    paths) default to the process-wide instances; pass fresh instances to
+    isolate. Mempool→block replays skip interpretation and the device
+    entirely on repeat batches.
     """
     if verifier is None:
         verifier = default_verifier()
+    if sig_cache is None:
+        sig_cache = default_sig_cache()
+    if script_cache is None:
+        script_cache = default_script_cache()
 
     tx_cache: Dict[bytes, Tx] = {}
     txdata_cache: Dict[int, PrecomputedTxData] = {}
     preps = [_prepare(item, tx_cache) for item in items]
+
+    # Script-execution cache probe: a hit certifies this exact
+    # (wtxid, input, flags, prevouts) succeeded before — skip the
+    # interpreter and the device outright (validation.cpp:1529-1536).
+    spent_digests: List[Optional[bytes]] = [None] * len(items)
+    for idx, (item, prep) in enumerate(zip(items, preps)):
+        if prep.result is not None or prep.tx is None:
+            continue
+        outs = (
+            item.spent_outputs
+            if item.spent_outputs is not None
+            else [(item.amount, item.spent_output_script or b"")]
+        )
+        digest = ScriptExecutionCache.spent_digest(outs)
+        spent_digests[idx] = digest
+        if script_cache.contains_input(
+            prep.tx.wtxid, item.input_index, item.flags, digest
+        ):
+            prep.result = BatchResult.success()
     # Share PrecomputedTxData between items of the same tx (one hash pass
     # per tx, as in validation.cpp:1538-1549).
     for prep in preps:
@@ -195,7 +231,8 @@ def verify_batch(
         prep.optimistic = (ok, err)
         prep.checks = checker.recorded
 
-    # Phase 2: one deduplicated device dispatch for every recorded check.
+    # Phase 2: sig-cache probe, then one deduplicated device dispatch for
+    # every remaining recorded check (sigcache.cpp:101-122 seam).
     unique: Dict[Tuple, int] = {}
     ordered: List[SigCheck] = []
     for prep in preps:
@@ -204,12 +241,23 @@ def verify_batch(
             if key not in unique:
                 unique[key] = len(ordered)
                 ordered.append(chk)
-    results = verifier.verify_checks(ordered) if ordered else []
+    known: List[Optional[bool]] = [
+        True if sig_cache.contains_check(c.kind, c.data) else None for c in ordered
+    ]
+    to_run = [i for i, k in enumerate(known) if k is None]
+    if to_run:
+        run_res = verifier.verify_checks([ordered[i] for i in to_run])
+        for i, r in zip(to_run, run_res):
+            known[i] = bool(r)
+            if r:  # success-only insertion, like the reference
+                sig_cache.add_check(ordered[i].kind, ordered[i].data)
+    results = known
 
     # Phase 3: accept optimistic verdicts; re-run exactly where any curve
-    # check came back False (its result feeds control flow).
+    # check came back False (its result feeds control flow). Successful
+    # inputs feed the script-execution cache for future batches.
     out: List[BatchResult] = []
-    for item, prep in zip(items, preps):
+    for idx, (item, prep) in enumerate(zip(items, preps)):
         if prep.result is not None:
             out.append(prep.result)
             continue
@@ -230,6 +278,10 @@ def verify_batch(
                 checker,
             )
         if ok:
+            if spent_digests[idx] is not None:
+                script_cache.add_input(
+                    prep.tx.wtxid, item.input_index, item.flags, spent_digests[idx]
+                )
             out.append(BatchResult.success())
         else:
             out.append(BatchResult(False, Error.ERR_SCRIPT, err))

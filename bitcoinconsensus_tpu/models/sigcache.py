@@ -1,0 +1,179 @@
+"""Cross-batch signature & script-execution caches.
+
+Production Bitcoin Core skips re-verification of signatures it already
+checked at mempool acceptance when the same tx appears in a block: a
+salted-SHA256-keyed cuckoo set for (sighash, pubkey, sig) triples
+(`script/sigcache.cpp:22-122`) and a second one for whole-tx script
+success keyed on wtxid+flags (`validation.cpp:1477-1495,1529-1536`). Both
+store *successes only* — failure is never cached, so a cache bug can only
+cost work, not consensus.
+
+TPU-era equivalents, same contract:
+
+- `SigCache`: batch-dispatch front-end — hits resolve without shipping the
+  lane to the device; verified-true lanes are inserted after each
+  dispatch.
+- `ScriptExecutionCache`: per-(wtxid, input, flags, spent-outputs) script
+  success, probed before interpretation. The spent-outputs digest is part
+  of the key because our API (unlike Core's UTXO view) lets callers
+  supply arbitrary prevouts for the same tx.
+
+Keys are salted per process (`os.urandom`) exactly as the reference salts
+its hashers (sigcache.cpp:22-30) — entries are never addressable across
+processes, so a poisoned entry cannot be constructed offline. Storage is
+a bounded LRU (OrderedDict) rather than a cuckoo table: the reference's
+cuckoo design buys lock-free concurrent probes on 32 B entries; under the
+GIL an LRU dict has the same asymptotics with far less machinery. All
+methods hold a mutex, making concurrent `verify_batch` calls safe — the
+thread contract the reference documents for its own globals
+(`pubkey.h:257-258`) and SURVEY §5 requires of ours.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import threading
+from collections import OrderedDict
+from typing import Iterable, Optional, Tuple
+
+__all__ = [
+    "SigCache",
+    "ScriptExecutionCache",
+    "default_sig_cache",
+    "default_script_cache",
+]
+
+
+class _SaltedLRU:
+    """Bounded success-set with a per-process salted key digest."""
+
+    def __init__(self, max_entries: int):
+        assert max_entries > 0
+        self._salt = os.urandom(32)
+        self._max = max_entries
+        self._set: OrderedDict[bytes, None] = OrderedDict()
+        self._lock = threading.Lock()
+        self.hits = 0
+        self.misses = 0
+
+    def _key(self, parts: Iterable[bytes]) -> bytes:
+        h = hashlib.sha256(self._salt)
+        for p in parts:
+            h.update(len(p).to_bytes(4, "little"))
+            h.update(p)
+        return h.digest()
+
+    def contains(self, parts: Iterable[bytes], erase: bool = False) -> bool:
+        k = self._key(parts)
+        with self._lock:
+            if k in self._set:
+                self.hits += 1
+                if erase:
+                    del self._set[k]
+                else:
+                    self._set.move_to_end(k)
+                return True
+            self.misses += 1
+            return False
+
+    def add(self, parts: Iterable[bytes]) -> None:
+        k = self._key(parts)
+        with self._lock:
+            self._set[k] = None
+            self._set.move_to_end(k)
+            while len(self._set) > self._max:
+                self._set.popitem(last=False)
+
+    def __len__(self) -> int:
+        return len(self._set)
+
+
+class SigCache(_SaltedLRU):
+    """Valid-signature set over deferred curve checks (sigcache.cpp:22-122).
+
+    A `SigCheck`'s (kind, data) tuple is flattened into the salted digest;
+    `contains` on a hit refreshes recency (Core's mempool->block pattern
+    uses erase-on-hit from the block path; pass erase=True to match)."""
+
+    def __init__(self, max_entries: int = 1 << 16):
+        super().__init__(max_entries)
+
+    @staticmethod
+    def _parts(kind: str, data: Tuple) -> Tuple[bytes, ...]:
+        parts = [kind.encode()]
+        for d in data:
+            parts.append(
+                d if isinstance(d, bytes) else int(d).to_bytes(4, "little", signed=True)
+            )
+        return tuple(parts)
+
+    def contains_check(self, kind: str, data: Tuple, erase: bool = False) -> bool:
+        return self.contains(self._parts(kind, data), erase=erase)
+
+    def add_check(self, kind: str, data: Tuple) -> None:
+        self.add(self._parts(kind, data))
+
+
+class ScriptExecutionCache(_SaltedLRU):
+    """Per-input script success keyed on (wtxid, input index, flags,
+    spent-outputs digest) — validation.cpp:1529-1536 reshaped to the
+    per-input batch API."""
+
+    def __init__(self, max_entries: int = 1 << 15):
+        super().__init__(max_entries)
+
+    @staticmethod
+    def _parts(
+        wtxid: bytes, n_in: int, flags: int, spent_digest: bytes
+    ) -> Tuple[bytes, ...]:
+        return (
+            wtxid,
+            n_in.to_bytes(4, "little"),
+            flags.to_bytes(4, "little"),
+            spent_digest,
+        )
+
+    @staticmethod
+    def spent_digest(spent_outputs) -> bytes:
+        """Digest of the (amount, scriptPubKey) list a caller supplied
+        (empty-sentinel for the legacy single-prevout form)."""
+        h = hashlib.sha256()
+        if spent_outputs is None:
+            return b"\x00" * 32
+        for amt, spk in spent_outputs:
+            h.update(int(amt).to_bytes(8, "little", signed=True))
+            h.update(len(spk).to_bytes(4, "little"))
+            h.update(spk)
+        return h.digest()
+
+    def contains_input(
+        self, wtxid: bytes, n_in: int, flags: int, spent_digest: bytes
+    ) -> bool:
+        return self.contains(self._parts(wtxid, n_in, flags, spent_digest))
+
+    def add_input(
+        self, wtxid: bytes, n_in: int, flags: int, spent_digest: bytes
+    ) -> None:
+        self.add(self._parts(wtxid, n_in, flags, spent_digest))
+
+
+_default_sig: Optional[SigCache] = None
+_default_script: Optional[ScriptExecutionCache] = None
+_default_lock = threading.Lock()
+
+
+def default_sig_cache() -> SigCache:
+    global _default_sig
+    with _default_lock:
+        if _default_sig is None:
+            _default_sig = SigCache()
+        return _default_sig
+
+
+def default_script_cache() -> ScriptExecutionCache:
+    global _default_script
+    with _default_lock:
+        if _default_script is None:
+            _default_script = ScriptExecutionCache()
+        return _default_script

@@ -1,0 +1,319 @@
+"""Block-connect pipeline: the north-star replay driver (SURVEY §3.5).
+
+TPU-era reshaping of the reference's `ConnectBlock` stack
+(`validation.cpp:1946` → `CheckInputScripts` `:1516-1599` →
+`CScriptCheck::operator()` `:1464-1468`): where Core fans per-input script
+checks onto a thread-pool queue (`checkqueue.h:29-163`), this driver runs
+every input's script through the deferring interpreter and resolves the
+whole block's signature algebra in batched TPU dispatches via
+`verify_batch` — signature-level batching replaces thread-level
+parallelism.
+
+Scope: the consensus rules that are functions of (block, UTXO view,
+height) — input existence, coinbase maturity, value conservation, sigop
+cost, script validity, coinbase reward. Chain-context rules that need
+headers/median-time (BIP34 height-in-coinbase, BIP68 sequence locks,
+nLockTime finality, difficulty retarget) sit above this layer, exactly as
+they sit above `CheckInputScripts` in the reference.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Tuple
+
+from ..core.block import (
+    Block,
+    MAX_BLOCK_SIGOPS_COST,
+    POW_LIMIT_MAINNET,
+    check_block,
+    check_witness_commitment,
+)
+from ..core.flags import (
+    VERIFY_P2SH,
+    VERIFY_WITNESS,
+    height_to_flags,
+)
+from ..core.script import (
+    get_sig_op_count,
+    is_p2sh,
+    is_push_only,
+    is_witness_program,
+    iter_ops,
+    witness_sig_ops,
+)
+from ..core.tx import COIN, MAX_MONEY, OutPoint, Tx, TxOut
+from ..core.tx_check import WITNESS_SCALE_FACTOR
+from ..crypto.jax_backend import TpuSecpVerifier
+from .batch import BatchItem, BatchResult, verify_batch
+from .sigcache import ScriptExecutionCache, SigCache
+
+__all__ = [
+    "Coin",
+    "CoinsView",
+    "ConnectResult",
+    "connect_block",
+    "count_witness_sigops",
+    "get_transaction_sigop_cost",
+    "get_block_subsidy",
+    "COINBASE_MATURITY",
+]
+
+COINBASE_MATURITY = 100  # consensus/consensus.h:19
+SUBSIDY_HALVING_INTERVAL = 210_000  # chainparams.cpp mainnet
+
+
+@dataclass
+class Coin:
+    """One unspent output + its creation metadata (coins.h Coin)."""
+
+    out: TxOut
+    height: int = 0
+    coinbase: bool = False
+
+
+class CoinsView:
+    """Dict-backed UTXO set, the `CCoinsViewCache` role in ConnectBlock."""
+
+    def __init__(self):
+        self._map: Dict[Tuple[bytes, int], Coin] = {}
+
+    def add(self, outpoint: OutPoint, coin: Coin) -> None:
+        self._map[(outpoint.hash, outpoint.n)] = coin
+
+    def add_tx(self, tx: Tx, height: int) -> None:
+        cb = tx.is_coinbase()
+        for n, out in enumerate(tx.vout):
+            self._map[(tx.txid, n)] = Coin(out, height, cb)
+
+    def get(self, outpoint: OutPoint) -> Optional[Coin]:
+        return self._map.get((outpoint.hash, outpoint.n))
+
+    def spend(self, outpoint: OutPoint) -> Optional[Coin]:
+        return self._map.pop((outpoint.hash, outpoint.n), None)
+
+    def __len__(self) -> int:
+        return len(self._map)
+
+
+def get_block_subsidy(height: int) -> int:
+    """GetBlockSubsidy (validation.cpp:1246-1257)."""
+    halvings = height // SUBSIDY_HALVING_INTERVAL
+    if halvings >= 64:
+        return 0
+    return (50 * COIN) >> halvings
+
+
+def count_witness_sigops(
+    script_sig: bytes, script_pubkey: bytes, witness: List[bytes], flags: int
+) -> int:
+    """CountWitnessSigOps (interpreter.cpp:2074-2103)."""
+    if not (flags & VERIFY_WITNESS):
+        return 0
+    assert flags & VERIFY_P2SH
+    wp = is_witness_program(script_pubkey)
+    if wp is not None:
+        return witness_sig_ops(wp[0], wp[1], witness)
+    if is_p2sh(script_pubkey) and is_push_only(script_sig):
+        data = b""
+        for _opcode, pushed in iter_ops(script_sig):
+            data = pushed if pushed is not None else b""
+        wp = is_witness_program(data)
+        if wp is not None:
+            return witness_sig_ops(wp[0], wp[1], witness)
+    return 0
+
+
+def get_transaction_sigop_cost(
+    tx: Tx, spent_outputs: List[TxOut], flags: int
+) -> int:
+    """GetTransactionSigOpCost (consensus/tx_verify.cpp:125-147): legacy
+    sigops ×4 + P2SH redeem sigops ×4 + witness sigops ×1."""
+    cost = 0
+    for txin in tx.vin:
+        cost += get_sig_op_count(txin.script_sig, accurate=False)
+    for txout in tx.vout:
+        cost += get_sig_op_count(txout.script_pubkey, accurate=False)
+    cost *= WITNESS_SCALE_FACTOR
+    if tx.is_coinbase():
+        return cost
+    if flags & VERIFY_P2SH:
+        p2sh = 0
+        for txin, prevout in zip(tx.vin, spent_outputs):
+            if is_p2sh(prevout.script_pubkey) and is_push_only(txin.script_sig):
+                data = b""
+                for _opcode, pushed in iter_ops(txin.script_sig):
+                    data = pushed if pushed is not None else b""
+                p2sh += get_sig_op_count(data, accurate=True)
+        cost += p2sh * WITNESS_SCALE_FACTOR
+    for txin, prevout in zip(tx.vin, spent_outputs):
+        cost += count_witness_sigops(
+            txin.script_sig, prevout.script_pubkey, txin.witness, flags
+        )
+    return cost
+
+
+@dataclass
+class ConnectResult:
+    ok: bool
+    reason: Optional[str] = None
+    fees: int = 0
+    sigop_cost: int = 0
+    input_results: Optional[List[BatchResult]] = None
+
+    @property
+    def script_failures(self) -> List[int]:
+        if not self.input_results:
+            return []
+        return [i for i, r in enumerate(self.input_results) if not r.ok]
+
+
+def connect_block(
+    block: Block,
+    coins: CoinsView,
+    height: int,
+    flags: Optional[int] = None,
+    verifier: Optional[TpuSecpVerifier] = None,
+    check_pow: bool = True,
+    check_scripts: bool = True,
+    enforce_witness_commitment: Optional[bool] = None,
+    pow_limit: int = POW_LIMIT_MAINNET,
+    sig_cache: Optional[SigCache] = None,
+    script_cache: Optional[ScriptExecutionCache] = None,
+) -> ConnectResult:
+    """Validate and apply one block against the UTXO view.
+
+    Mirrors the consensus phases of `ConnectBlock` (validation.cpp:1946):
+
+    1. context-free `CheckBlock` (+ witness commitment when the flag era
+       includes WITNESS, matching IsWitnessEnabled gating);
+    2. per tx: inputs present & mature, value conservation, accumulated
+       sigop cost vs MAX_BLOCK_SIGOPS_COST (`validation.cpp:2155-2181`,
+       `consensus/tx_verify.cpp:157-218` CheckTxInputs);
+    3. all inputs' scripts through `verify_batch` — the signature-batched
+       stand-in for the CCheckQueue fan-out (`validation.cpp:2190`);
+    4. coinbase reward cap, then the view update (spend + add).
+
+    The view is mutated only when every check passes. `flags` defaults to
+    the mainnet `height_to_flags(height, extended=True)` schedule.
+    """
+    if flags is None:
+        flags = height_to_flags(height, extended=True)
+    if verifier is None and check_scripts:
+        from ..crypto.jax_backend import default_verifier
+
+        verifier = default_verifier()
+
+    ok, reason = check_block(block, check_pow=check_pow, pow_limit=pow_limit)
+    if not ok:
+        return ConnectResult(False, reason)
+    if enforce_witness_commitment is None:
+        enforce_witness_commitment = bool(flags & VERIFY_WITNESS)
+    if enforce_witness_commitment:
+        ok, reason = check_witness_commitment(block)
+        if not ok:
+            return ConnectResult(False, reason)
+
+    # Phase 2: inputs exist, maturity, values, sigop budget; gather the
+    # spent outputs each tx needs (validation.cpp:1538-1549) without
+    # mutating the view yet. Outputs created earlier in this same block are
+    # spendable by later txs (the in-block overlay below).
+    overlay: Dict[Tuple[bytes, int], Coin] = {}
+    spent: set = set()
+    per_tx_spent_outputs: List[List[TxOut]] = []
+    fees = 0
+    sigop_cost = 0
+
+    for tx in block.vtx:
+        if tx.is_coinbase():
+            per_tx_spent_outputs.append([])
+            sigop_cost += get_transaction_sigop_cost(tx, [], flags)
+            if sigop_cost > MAX_BLOCK_SIGOPS_COST:
+                return ConnectResult(False, "bad-blk-sigops")
+            overlay_tx_outputs(overlay, tx, height)
+            continue
+        spent_outputs: List[TxOut] = []
+        value_in = 0
+        for txin in tx.vin:
+            key = (txin.prevout.hash, txin.prevout.n)
+            if key in spent:
+                return ConnectResult(False, "bad-txns-inputs-missingorspent")
+            coin = overlay.get(key) or coins.get(txin.prevout)
+            if coin is None:
+                return ConnectResult(False, "bad-txns-inputs-missingorspent")
+            if coin.coinbase and height - coin.height < COINBASE_MATURITY:
+                return ConnectResult(False, "bad-txns-premature-spend-of-coinbase")
+            if not (0 <= coin.out.value <= MAX_MONEY):
+                return ConnectResult(False, "bad-txns-inputvalues-outofrange")
+            value_in += coin.out.value
+            # Accumulated value must stay in range too (CheckTxInputs,
+            # consensus/tx_verify.cpp:157-218 MoneyRange(nValueIn)).
+            if value_in > MAX_MONEY:
+                return ConnectResult(False, "bad-txns-inputvalues-outofrange")
+            spent_outputs.append(coin.out)
+            spent.add(key)
+        value_out = sum(o.value for o in tx.vout)
+        if value_in < value_out:
+            return ConnectResult(False, "bad-txns-in-belowout")
+        fee = value_in - value_out
+        fees += fee
+        if not (0 <= fees <= MAX_MONEY):
+            return ConnectResult(False, "bad-txns-fee-outofrange")
+        sigop_cost += get_transaction_sigop_cost(tx, spent_outputs, flags)
+        if sigop_cost > MAX_BLOCK_SIGOPS_COST:
+            return ConnectResult(False, "bad-blk-sigops")
+        per_tx_spent_outputs.append(spent_outputs)
+        overlay_tx_outputs(overlay, tx, height)
+
+    # Coinbase reward cap (validation.cpp:2222-2228).
+    coinbase_out = sum(o.value for o in block.vtx[0].vout)
+    if coinbase_out > fees + get_block_subsidy(height):
+        return ConnectResult(False, "bad-cb-amount")
+
+    # Phase 3: every input's script, one batched dispatch
+    # (CheckInputScripts + CCheckQueue → verify_batch).
+    input_results: Optional[List[BatchResult]] = None
+    if check_scripts:
+        items: List[BatchItem] = []
+        for tx, spent_outputs in zip(block.vtx, per_tx_spent_outputs):
+            if tx.is_coinbase():
+                continue
+            raw = tx.serialize()
+            outs = [(o.value, o.script_pubkey) for o in spent_outputs]
+            for i in range(len(tx.vin)):
+                items.append(
+                    BatchItem(
+                        spending_tx=raw,
+                        input_index=i,
+                        flags=flags,
+                        spent_outputs=outs,
+                    )
+                )
+        input_results = verify_batch(
+            items,
+            verifier=verifier,
+            sig_cache=sig_cache,
+            script_cache=script_cache,
+        )
+        if not all(r.ok for r in input_results):
+            return ConnectResult(
+                False, "block-validation-failed", fees, sigop_cost, input_results
+            )
+
+    # Phase 4: apply to the view (UpdateCoins, coins.cpp).
+    for tx in block.vtx:
+        for txin in tx.vin:
+            if not tx.is_coinbase():
+                coins.spend(txin.prevout)
+        coins.add_tx(tx, height)
+    return ConnectResult(True, None, fees, sigop_cost, input_results)
+
+
+def overlay_tx_outputs(
+    overlay: Dict[Tuple[bytes, int], Coin], tx: Tx, height: int
+) -> None:
+    """Record a tx's outputs in the in-block overlay so later txs of the
+    same block can spend them (Core applies UpdateCoins per tx in order)."""
+    cb = tx.is_coinbase()
+    for n, out in enumerate(tx.vout):
+        overlay[(tx.txid, n)] = Coin(out, height, cb)

@@ -1,0 +1,207 @@
+"""Batched SHA-256 on device — fixed-layout messages, lane-parallel.
+
+The reference computes every hash serially on the CPU (`crypto/sha256.cpp`
+generic transform; the SIMD multiway variants exist but are not compiled,
+SURVEY §2.1). The TPU-native reshaping: one compression function traced
+over a batch axis, whole-array uint32 ops on the VPU — every lane advances
+through the 64 rounds in lockstep. Schedules are fixed at trace time by
+the (static) message length, which is exactly the shape of the consensus
+workloads:
+
+- BIP340 tagged hashes: 64-byte tag prefix collapses into a precomputed
+  midstate (the reference hardcodes the same midstates,
+  `modules/schnorrsig/main_impl.h:16-44,96-109`), then a fixed 96-byte
+  payload (challenge: r.x ‖ pk.x ‖ msg).
+- BIP143/BIP341 sighash preimages: fixed layout per (script_code length)
+  bucket; double SHA-256.
+
+`sha256_fixed` handles any static length ≥ 0 with optional midstate;
+`sha256d_fixed` is the double-SHA convenience; `bip340_challenge` is the
+batched challenge hash the Schnorr verify path uses. All return big-endian
+byte arrays, bit-identical to hashlib (asserted by tests/test_ops_sha256.py).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import struct
+
+import numpy as np
+
+import jax.numpy as jnp
+
+__all__ = [
+    "sha256_compress",
+    "sha256_fixed",
+    "sha256d_fixed",
+    "tag_midstate",
+    "bip340_challenge",
+    "CHALLENGE_MIDSTATE",
+]
+
+_H0 = np.array(
+    [
+        0x6A09E667, 0xBB67AE85, 0x3C6EF372, 0xA54FF53A,
+        0x510E527F, 0x9B05688C, 0x1F83D9AB, 0x5BE0CD19,
+    ],
+    dtype=np.uint32,
+)
+
+_K = np.array(
+    [
+        0x428A2F98, 0x71374491, 0xB5C0FBCF, 0xE9B5DBA5, 0x3956C25B, 0x59F111F1,
+        0x923F82A4, 0xAB1C5ED5, 0xD807AA98, 0x12835B01, 0x243185BE, 0x550C7DC3,
+        0x72BE5D74, 0x80DEB1FE, 0x9BDC06A7, 0xC19BF174, 0xE49B69C1, 0xEFBE4786,
+        0x0FC19DC6, 0x240CA1CC, 0x2DE92C6F, 0x4A7484AA, 0x5CB0A9DC, 0x76F988DA,
+        0x983E5152, 0xA831C66D, 0xB00327C8, 0xBF597FC7, 0xC6E00BF3, 0xD5A79147,
+        0x06CA6351, 0x14292967, 0x27B70A85, 0x2E1B2138, 0x4D2C6DFC, 0x53380D13,
+        0x650A7354, 0x766A0ABB, 0x81C2C92E, 0x92722C85, 0xA2BFE8A1, 0xA81A664B,
+        0xC24B8B70, 0xC76C51A3, 0xD192E819, 0xD6990624, 0xF40E3585, 0x106AA070,
+        0x19A4C116, 0x1E376C08, 0x2748774C, 0x34B0BCB5, 0x391C0CB3, 0x4ED8AA4A,
+        0x5B9CCA4F, 0x682E6FF3, 0x748F82EE, 0x78A5636F, 0x84C87814, 0x8CC70208,
+        0x90BEFFFA, 0xA4506CEB, 0xBEF9A3F7, 0xC67178F2,
+    ],
+    dtype=np.uint32,
+)
+
+
+def _rotr(x, n: int):
+    # x is uint32: >> is a logical shift for unsigned dtypes.
+    return (x >> n) | (x << (32 - n))
+
+
+def _shr(x, n: int):
+    return x >> n
+
+
+def sha256_compress(state, block):
+    """One SHA-256 compression: state (8, ...) uint32, block (16, ...)
+    uint32 big-endian words. Returns the new (8, ...) state. Whole-array
+    ops only; the batch rides the trailing axes."""
+    w = [block[i] for i in range(16)]
+    for i in range(16, 64):
+        s0 = _rotr(w[i - 15], 7) ^ _rotr(w[i - 15], 18) ^ _shr(w[i - 15], 3)
+        s1 = _rotr(w[i - 2], 17) ^ _rotr(w[i - 2], 19) ^ _shr(w[i - 2], 10)
+        w.append(w[i - 16] + s0 + w[i - 7] + s1)
+
+    a, b, c, d, e, f, g, h = (state[i] for i in range(8))
+    for i in range(64):
+        S1 = _rotr(e, 6) ^ _rotr(e, 11) ^ _rotr(e, 25)
+        ch = (e & f) ^ (~e & g)
+        t1 = h + S1 + ch + jnp.uint32(int(_K[i])) + w[i]
+        S0 = _rotr(a, 2) ^ _rotr(a, 13) ^ _rotr(a, 22)
+        maj = (a & b) ^ (a & c) ^ (b & c)
+        t2 = S0 + maj
+        h, g, f, e, d, c, b, a = g, f, e, d + t1, c, b, a, t1 + t2
+    out = jnp.stack([a, b, c, d, e, f, g, h], axis=0)
+    return out + state
+
+
+def _words_from_bytes(data):
+    """(..., 4k) uint8 -> (k, ...) big-endian uint32 words (word-major)."""
+    u = data.astype(jnp.uint32)
+    w = (
+        (u[..., 0::4] << 24)
+        | (u[..., 1::4] << 16)
+        | (u[..., 2::4] << 8)
+        | u[..., 3::4]
+    )
+    return jnp.moveaxis(w, -1, 0)
+
+
+def _bytes_from_words(words):
+    """(8, ...) uint32 -> (..., 32) uint8 big-endian digest bytes."""
+    w = jnp.moveaxis(words, 0, -1).astype(jnp.uint32)  # (..., 8)
+    shifts = jnp.asarray([24, 16, 8, 0], dtype=jnp.uint32)
+    b = (w[..., :, None] >> shifts) & jnp.uint32(0xFF)
+    return b.reshape(b.shape[:-2] + (32,)).astype(jnp.uint8)
+
+
+def _padding(total_len: int) -> bytes:
+    """Static SHA-256 padding for a hashed stream (incl. any
+    midstate-consumed prefix) totalling `total_len` bytes."""
+    pad = b"\x80" + b"\x00" * ((55 - total_len) % 64)
+    return pad + struct.pack(">Q", total_len * 8)
+
+
+def sha256_fixed(data, midstate=None, prefix_len: int = 0):
+    """Batched SHA-256 of fixed-length messages.
+
+    data: (..., L) uint8 with static L. midstate: optional (8,) or (8, ...)
+    uint32 chaining state that already consumed `prefix_len` bytes (must be
+    a multiple of 64). Returns (..., 32) uint8 digests.
+    """
+    L = data.shape[-1]
+    assert prefix_len % 64 == 0
+    pad = _padding(prefix_len + L)
+    batch_shape = data.shape[:-1]
+    padv = jnp.broadcast_to(
+        jnp.asarray(np.frombuffer(pad, dtype=np.uint8)), batch_shape + (len(pad),)
+    )
+    stream = jnp.concatenate([data, padv], axis=-1)
+    n_blocks = stream.shape[-1] // 64
+    assert stream.shape[-1] % 64 == 0
+
+    if midstate is None:
+        state = jnp.broadcast_to(
+            jnp.asarray(_H0).reshape((8,) + (1,) * len(batch_shape)),
+            (8,) + batch_shape,
+        )
+    else:
+        ms = jnp.asarray(midstate, dtype=jnp.uint32)
+        if ms.ndim == 1:
+            ms = ms.reshape((8,) + (1,) * len(batch_shape))
+        state = jnp.broadcast_to(ms, (8,) + batch_shape)
+    for i in range(n_blocks):
+        block = _words_from_bytes(stream[..., i * 64 : (i + 1) * 64])
+        state = sha256_compress(state, block)
+    return _bytes_from_words(state)
+
+
+def sha256d_fixed(data, midstate=None, prefix_len: int = 0):
+    """Double SHA-256 (CHash256, hash.h:24) of fixed-length messages."""
+    return sha256_fixed(sha256_fixed(data, midstate, prefix_len))
+
+
+def tag_midstate(tag: str) -> np.ndarray:
+    """(8,) uint32 chaining state after SHA256(tag)‖SHA256(tag) — the
+    64-byte prefix every BIP340 tagged hash starts with (hash.cpp:89-96;
+    hardcoded equivalents at schnorrsig/main_impl.h:16-44)."""
+    th = hashlib.sha256(tag.encode()).digest()
+    state = _H0.copy()
+    block = np.frombuffer(th + th, dtype=np.uint8)
+    # One host-side compression over the doubled tag hash.
+    s = [int(x) for x in state]
+    w = list(struct.unpack(">16I", block.tobytes()))
+    for i in range(16, 64):
+        s0 = _py_rotr(w[i - 15], 7) ^ _py_rotr(w[i - 15], 18) ^ (w[i - 15] >> 3)
+        s1 = _py_rotr(w[i - 2], 17) ^ _py_rotr(w[i - 2], 19) ^ (w[i - 2] >> 10)
+        w.append((w[i - 16] + s0 + w[i - 7] + s1) & 0xFFFFFFFF)
+    a, b, c, d, e, f, g, h = s
+    for i in range(64):
+        S1 = _py_rotr(e, 6) ^ _py_rotr(e, 11) ^ _py_rotr(e, 25)
+        ch = (e & f) ^ (~e & g) & 0xFFFFFFFF
+        t1 = (h + S1 + (ch & 0xFFFFFFFF) + int(_K[i]) + w[i]) & 0xFFFFFFFF
+        S0 = _py_rotr(a, 2) ^ _py_rotr(a, 13) ^ _py_rotr(a, 22)
+        maj = (a & b) ^ (a & c) ^ (b & c)
+        t2 = (S0 + maj) & 0xFFFFFFFF
+        h, g, f, e, d, c, b, a = g, f, e, (d + t1) & 0xFFFFFFFF, c, b, a, (t1 + t2) & 0xFFFFFFFF
+    return np.array(
+        [(x + y) & 0xFFFFFFFF for x, y in zip([a, b, c, d, e, f, g, h], s)],
+        dtype=np.uint32,
+    )
+
+
+def _py_rotr(x: int, n: int) -> int:
+    return ((x >> n) | (x << (32 - n))) & 0xFFFFFFFF
+
+
+CHALLENGE_MIDSTATE = tag_midstate("BIP0340/challenge")
+
+
+def bip340_challenge(r32, px32, m32):
+    """Batched BIP340 challenge e = tagged(r.x ‖ pk.x ‖ m): (..., 32) uint8
+    triples -> (..., 32) uint8 digests. Midstate skips the tag block; two
+    compressions per lane (schnorrsig/main_impl.h:111-125)."""
+    payload = jnp.concatenate([r32, px32, m32], axis=-1)
+    return sha256_fixed(payload, midstate=CHALLENGE_MIDSTATE, prefix_len=64)

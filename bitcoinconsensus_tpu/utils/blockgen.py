@@ -1,0 +1,257 @@
+"""Synthetic funded-UTXO and block generators (tests + benchmarks only).
+
+The reference's bench layer drives `VerifyScript` with a hand-built P2WPKH
+spend (`depend/bitcoin/src/bench/verify_script.cpp:19-76`) and its block
+bench replays a fixed mainnet block (`bench/checkblock.cpp:17-45`). This
+module generalizes that: deterministic keys, funded `CoinsView`s, signed
+spends across the script families the BASELINE configs name (P2PKH,
+P2WPKH, P2WSH 2-of-3 CHECKMULTISIG, P2TR key path), and fully valid blocks
+(merkle root, witness commitment, regtest-grade proof of work) for the
+block-replay north star. Never imported by the production verify path.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import struct
+from typing import List, Optional, Sequence, Tuple
+
+from ..core.block import Block, BlockHeader, block_merkle_root, check_proof_of_work
+from ..core.script import OP_CHECKMULTISIG, OP_RETURN, push_data
+from ..core.sighash import (
+    SIGHASH_ALL,
+    SIGHASH_DEFAULT,
+    PrecomputedTxData,
+    SigVersion,
+    bip143_sighash,
+    bip341_sighash,
+    legacy_sighash,
+)
+from ..core.tx import COIN, OutPoint, Tx, TxIn, TxOut
+from ..crypto import secp_host as H
+from ..models.validate import Coin, CoinsView, get_block_subsidy
+from .hashes import hash160, sha256d, tagged_hash
+
+__all__ = [
+    "KINDS",
+    "Wallet",
+    "FundedOutput",
+    "make_funded_view",
+    "build_spend_tx",
+    "build_block",
+    "REGTEST_POW_LIMIT",
+    "REGTEST_BITS",
+]
+
+# Regtest-grade PoW so test/bench blocks mine in a handful of nonce tries
+# (chainparams.cpp regtest powLimit / genesis nBits).
+REGTEST_POW_LIMIT = 0x7FFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFF
+REGTEST_BITS = 0x207FFFFF
+
+KINDS = ("p2pkh", "p2wpkh", "p2wsh_multisig", "p2tr")
+
+
+def _sk(seed: str) -> int:
+    return int.from_bytes(hashlib.sha256(seed.encode()).digest(), "big") % (H.N - 1) + 1
+
+
+class Wallet:
+    """Deterministic per-seed key material for one output `kind`."""
+
+    def __init__(self, seed: str, kind: str):
+        assert kind in KINDS
+        self.kind = kind
+        self.seed = seed
+        if kind == "p2wsh_multisig":
+            self.sks = [_sk(f"{seed}/k{i}") for i in range(3)]
+            self.pubs = [H.pubkey_create(sk) for sk in self.sks]
+            # 2-of-3: OP_2 <pk0> <pk1> <pk2> OP_3 OP_CHECKMULTISIG
+            self.witness_script = (
+                b"\x52"
+                + b"".join(push_data(p) for p in self.pubs)
+                + b"\x53"
+                + bytes([OP_CHECKMULTISIG])
+            )
+            self.spk = b"\x00\x20" + hashlib.sha256(self.witness_script).digest()
+        elif kind == "p2tr":
+            d = _sk(seed)
+            px, parity = H.xonly_pubkey_create(d)
+            d_even = d if parity == 0 else H.N - d
+            t = int.from_bytes(tagged_hash("TapTweak", px), "big") % H.N
+            self.out_sk = (d_even + t) % H.N
+            qx, _ = H.xonly_pubkey_create(self.out_sk)
+            self.spk = b"\x51\x20" + qx
+        else:
+            self.sk = _sk(seed)
+            self.pub = H.pubkey_create(self.sk)
+            h = hash160(self.pub)
+            if kind == "p2pkh":
+                self.spk = b"\x76\xa9" + push_data(h) + b"\x88\xac"
+            else:  # p2wpkh
+                self.spk = b"\x00\x14" + h
+
+    def sign_input(
+        self,
+        tx: Tx,
+        n_in: int,
+        amount: int,
+        txdata: Optional[PrecomputedTxData] = None,
+        corrupt: bool = False,
+    ) -> None:
+        """Fill scriptSig/witness of tx.vin[n_in] spending this wallet's spk."""
+        if self.kind == "p2pkh":
+            sighash = legacy_sighash(self.spk, tx, n_in, SIGHASH_ALL)
+            sig = H.sign_ecdsa(self.sk, sighash) + bytes([SIGHASH_ALL])
+            if corrupt:
+                sig = _flip(sig, 9)
+            tx.vin[n_in].script_sig = push_data(sig) + push_data(self.pub)
+        elif self.kind == "p2wpkh":
+            code = b"\x76\xa9" + push_data(hash160(self.pub)) + b"\x88\xac"
+            sighash = bip143_sighash(code, tx, n_in, SIGHASH_ALL, amount)
+            sig = H.sign_ecdsa(self.sk, sighash) + bytes([SIGHASH_ALL])
+            if corrupt:
+                sig = _flip(sig, 9)
+            tx.vin[n_in].witness = [sig, self.pub]
+        elif self.kind == "p2wsh_multisig":
+            sighash = bip143_sighash(
+                self.witness_script, tx, n_in, SIGHASH_ALL, amount
+            )
+            sigs = [
+                H.sign_ecdsa(sk, sighash) + bytes([SIGHASH_ALL])
+                for sk in self.sks[:2]
+            ]
+            if corrupt:
+                sigs[0] = _flip(sigs[0], 9)
+            tx.vin[n_in].witness = [b""] + sigs + [self.witness_script]
+        else:  # p2tr key path
+            assert txdata is not None, "taproot signing needs PrecomputedTxData"
+            sighash = bip341_sighash(
+                tx, n_in, SIGHASH_DEFAULT, SigVersion.TAPROOT, txdata, False, b""
+            )
+            sig = H.sign_schnorr(self.out_sk, sighash)
+            if corrupt:
+                sig = _flip(sig, 40)
+            tx.vin[n_in].witness = [sig]
+
+
+def _flip(b: bytes, i: int) -> bytes:
+    return b[:i] + bytes([b[i] ^ 1]) + b[i + 1 :]
+
+
+class FundedOutput:
+    __slots__ = ("outpoint", "wallet", "amount")
+
+    def __init__(self, outpoint: OutPoint, wallet: Wallet, amount: int):
+        self.outpoint = outpoint
+        self.wallet = wallet
+        self.amount = amount
+
+
+def make_funded_view(
+    n: int,
+    kinds: Sequence[str] = KINDS,
+    amount: int = COIN // 100,
+    height: int = 1,
+    seed: str = "fund",
+) -> Tuple[CoinsView, List[FundedOutput]]:
+    """A CoinsView holding n outputs cycling through `kinds`."""
+    coins = CoinsView()
+    funded: List[FundedOutput] = []
+    for i in range(n):
+        kind = kinds[i % len(kinds)]
+        w = Wallet(f"{seed}/{i}", kind)
+        op = OutPoint(hashlib.sha256(f"{seed}/op/{i}".encode()).digest(), i & 0xFFFF)
+        coins.add(op, Coin(TxOut(amount, w.spk), height=height, coinbase=False))
+        funded.append(FundedOutput(op, w, amount))
+    return coins, funded
+
+
+def build_spend_tx(
+    inputs: Sequence[FundedOutput],
+    fee: int = 1000,
+    corrupt_input: Optional[int] = None,
+) -> Tx:
+    """One signed tx spending `inputs` to an anyone-can-spend output."""
+    total = sum(f.amount for f in inputs)
+    tx = Tx(
+        version=2,
+        vin=[TxIn(f.outpoint) for f in inputs],
+        vout=[TxOut(total - fee, b"\x51")],
+        locktime=0,
+    )
+    spent = [TxOut(f.amount, f.wallet.spk) for f in inputs]
+    # force=True: BIP341 readiness is normally inferred from witnesses,
+    # which are only attached below as each input signs.
+    txdata = (
+        PrecomputedTxData(tx, spent, force=True)
+        if any(f.wallet.kind == "p2tr" for f in inputs)
+        else None
+    )
+    for i, f in enumerate(inputs):
+        f.wallet.sign_input(
+            tx, i, f.amount, txdata=txdata, corrupt=(i == corrupt_input)
+        )
+    return tx
+
+
+def _make_coinbase(height: int, reward: int, with_witness_commitment: bool) -> Tx:
+    """Coinbase paying `reward`; BIP34 height push + optional BIP141
+    commitment placeholder (patched by build_block after the txs settle)."""
+    script_sig = push_data(struct.pack("<I", height).rstrip(b"\x00") or b"\x00") + b"\x00"
+    vout = [TxOut(reward, b"\x51")]
+    if with_witness_commitment:
+        vout.append(TxOut(0, bytes([OP_RETURN, 0x24]) + b"\xaa\x21\xa9\xed" + b"\x00" * 32))
+    tx = Tx(
+        version=1,
+        vin=[TxIn(OutPoint(b"\x00" * 32, 0xFFFFFFFF), script_sig, 0xFFFFFFFF)],
+        vout=vout,
+        locktime=0,
+    )
+    if with_witness_commitment:
+        tx.vin[0].witness = [b"\x00" * 32]
+    return tx
+
+
+def build_block(
+    txs: List[Tx],
+    height: int,
+    prev_hash: bytes = b"\x00" * 32,
+    fees: int = 0,
+    time: int = 1_600_000_000,
+    bits: int = REGTEST_BITS,
+    witness_commitment: bool = True,
+) -> Block:
+    """Assemble + mine a structurally valid block over `txs`.
+
+    Coinbase reward = subsidy(height) + fees; witness commitment recomputed
+    over the final tx list; nonce ground until the header clears the
+    regtest target (a few tries at REGTEST_BITS).
+    """
+    coinbase = _make_coinbase(
+        height, get_block_subsidy(height) + fees, witness_commitment
+    )
+    vtx = [coinbase] + txs
+    header = BlockHeader(
+        version=0x20000000,
+        prev_hash=prev_hash,
+        merkle_root=b"\x00" * 32,
+        time=time,
+        bits=bits,
+        nonce=0,
+    )
+    block = Block(header, vtx)
+    if witness_commitment:
+        from ..core.block import block_witness_merkle_root, witness_commitment_index
+
+        root, _ = block_witness_merkle_root(block)
+        commit = sha256d(root + coinbase.vin[0].witness[0])
+        idx = witness_commitment_index(block)
+        spk = coinbase.vout[idx].script_pubkey
+        coinbase.vout[idx] = TxOut(0, spk[:6] + commit)
+        # Coinbase mutated after caching: rebuild identity caches.
+        coinbase._txid = None
+        coinbase._wtxid = None
+    header.merkle_root = block_merkle_root(block)[0]
+    while not check_proof_of_work(block.hash, bits, REGTEST_POW_LIMIT):
+        header.nonce += 1
+    return block

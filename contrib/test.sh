@@ -1,0 +1,21 @@
+#!/bin/sh
+# Local test driver — the role of the reference's contrib/test.sh
+# (contrib/_test.sh:20-45): one command that runs the whole gate exactly
+# as CI does. Usage: sh contrib/test.sh [pytest args...]
+set -e
+cd "$(dirname "$0")/.."
+
+if command -v ruff >/dev/null 2>&1; then
+    echo "== lint"
+    ruff check --select E9,F .
+else
+    echo "== lint skipped (ruff not installed)"
+fi
+
+echo "== tests (CPU backend, 8 virtual devices via tests/conftest.py)"
+python -m pytest tests/ -x -q "$@"
+
+echo "== multichip dryrun (virtual 8-device CPU mesh)"
+python -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
+
+echo "== all green"

@@ -1,0 +1,104 @@
+"""Measure the reference CPU baseline (BASELINE.md mandate).
+
+Drives the reference consensus library (built by build_reference.sh from
+/root/reference sources; the same code path the crate's verify() binds,
+src/lib.rs:103-139 -> bitcoinconsensus.cpp:104) through ctypes for each
+BASELINE.json config the C ABI can express:
+
+  1. single P2PKH input verify()        (config 1)
+  2. P2WPKH ECDSA batch, per-input loop (config 2)
+  3. P2WSH 2-of-3 multisig batch        (config 3)
+  4. P2TR keypath                       (config 4 — UNREACHABLE via the
+     reference C ABI: no spent-outputs form, SURVEY §3.2; recorded null)
+
+Writes BASELINE_MEASURED.json at the repo root and prints it. The bench
+layer reads this file to report honest vs-CPU speedups.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from bitcoinconsensus_tpu.core.flags import VERIFY_ALL_LIBCONSENSUS
+from bitcoinconsensus_tpu.utils.blockgen import Wallet, build_spend_tx, make_funded_view
+from bitcoinconsensus_tpu.utils.refbridge import load_reference_lib
+
+# The crate's own P2PKH end-to-end vector (src/lib.rs:225-229), shared
+# with tests/test_api_verify.py.
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tests"))
+from test_api_verify import P2PKH_SPENDING, P2PKH_SPENT  # noqa: E402
+
+
+def _measure(fn, n: int, min_time: float = 1.0):
+    """Run fn() n-at-a-time until min_time elapsed; return calls/sec."""
+    t0 = time.perf_counter()
+    calls = 0
+    while True:
+        for _ in range(n):
+            fn()
+        calls += n
+        dt = time.perf_counter() - t0
+        if dt >= min_time:
+            return calls / dt
+
+
+def main() -> None:
+    ref = load_reference_lib()
+    if ref is None:
+        print(
+            "reference lib not built; run scripts/build_reference.sh first",
+            file=sys.stderr,
+        )
+        sys.exit(1)
+    flags = VERIFY_ALL_LIBCONSENSUS
+    results = {}
+
+    # Config 1: single P2PKH (legacy sighash, ECDSA).
+    spent = bytes.fromhex(P2PKH_SPENT)
+    spending = bytes.fromhex(P2PKH_SPENDING)
+    ok, err = ref.verify_with_flags(spent, 0, spending, 0, flags)
+    assert ok, (ok, err)
+    results["p2pkh_single_verifies_per_sec"] = round(
+        _measure(lambda: ref.verify_with_flags(spent, 0, spending, 0, flags), 50), 1
+    )
+
+    # Configs 2-3: synthetic single-input spends (unique keys/sigs), driven
+    # through the reference per input — its only execution model.
+    for kind, label, n in (
+        ("p2wpkh", "p2wpkh_verifies_per_sec", 2000),
+        ("p2wsh_multisig", "p2wsh_2of3_verifies_per_sec", 1000),
+    ):
+        _, funded = make_funded_view(n, kinds=(kind,), seed=f"cpu-{kind}")
+        cases = []
+        for f in funded:
+            tx = build_spend_tx([f])
+            cases.append((f.wallet.spk, f.amount, tx.serialize()))
+        for spk, amt, raw in cases[:4]:
+            ok, err = ref.verify_with_flags(spk, amt, raw, 0, flags)
+            assert ok, (kind, ok, err)
+        t0 = time.perf_counter()
+        for spk, amt, raw in cases:
+            ref.verify_with_flags(spk, amt, raw, 0, flags)
+        dt = time.perf_counter() - t0
+        results[label] = round(n / dt, 1)
+
+    # Config 4: taproot is unreachable through the reference C ABI
+    # (bitcoinconsensus.h:49-61 excludes TAPROOT; no spent-outputs form).
+    results["p2tr_keypath_verifies_per_sec"] = None
+    results["note_p2tr"] = "unreachable via reference C ABI (SURVEY §3.2)"
+    results["hardware"] = "host CPU, single thread, reference C++/C library"
+
+    out = os.path.join(os.path.dirname(__file__), "..", "BASELINE_MEASURED.json")
+    with open(out, "w") as fh:
+        json.dump(results, fh, indent=2)
+        fh.write("\n")
+    print(json.dumps(results, indent=2))
+
+
+if __name__ == "__main__":
+    main()

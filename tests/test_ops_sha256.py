@@ -1,0 +1,69 @@
+"""Device SHA-256 vs hashlib: bit-equality over batches, midstates, and
+the BIP340 challenge path (spec: crypto/sha256.cpp generic transform;
+tag midstates: schnorrsig/main_impl.h:16-44, hash.cpp:89-96)."""
+
+import hashlib
+import random
+
+import numpy as np
+
+from conftest import *  # noqa: F401,F403 (env setup)
+
+from bitcoinconsensus_tpu.ops.sha256 import (
+    bip340_challenge,
+    sha256_fixed,
+    sha256d_fixed,
+    tag_midstate,
+)
+from bitcoinconsensus_tpu.utils.hashes import tagged_hash
+
+
+def _batch(rng, n, length):
+    return np.frombuffer(
+        bytes(rng.randrange(256) for _ in range(n * length)), dtype=np.uint8
+    ).reshape(n, length)
+
+
+def test_sha256_fixed_lengths():
+    rng = random.Random(1)
+    # Lengths straddling every padding/block boundary case.
+    for length in (0, 1, 31, 32, 55, 56, 63, 64, 65, 96, 119, 120, 127, 128, 200):
+        data = _batch(rng, 5, length)
+        got = np.asarray(sha256_fixed(data))
+        for i in range(data.shape[0]):
+            want = hashlib.sha256(data[i].tobytes()).digest()
+            assert got[i].tobytes() == want, f"len={length} lane={i}"
+
+
+def test_sha256d():
+    rng = random.Random(2)
+    data = _batch(rng, 4, 80)  # block-header-sized
+    got = np.asarray(sha256d_fixed(data))
+    for i in range(4):
+        want = hashlib.sha256(hashlib.sha256(data[i].tobytes()).digest()).digest()
+        assert got[i].tobytes() == want
+
+
+def test_midstate_matches_prefix_hash():
+    # Hashing (tag||tag||payload) from scratch == midstate + payload.
+    rng = random.Random(3)
+    ms = tag_midstate("TapSighash")
+    th = hashlib.sha256(b"TapSighash").digest()
+    data = _batch(rng, 3, 100)
+    got = np.asarray(sha256_fixed(data, midstate=ms, prefix_len=64))
+    for i in range(3):
+        want = hashlib.sha256(th + th + data[i].tobytes()).digest()
+        assert got[i].tobytes() == want
+
+
+def test_bip340_challenge_batch():
+    rng = random.Random(4)
+    r = _batch(rng, 6, 32)
+    p = _batch(rng, 6, 32)
+    m = _batch(rng, 6, 32)
+    got = np.asarray(bip340_challenge(r, p, m))
+    for i in range(6):
+        want = tagged_hash(
+            "BIP0340/challenge", r[i].tobytes() + p[i].tobytes() + m[i].tobytes()
+        )
+        assert got[i].tobytes() == want

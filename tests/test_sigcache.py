@@ -1,0 +1,99 @@
+"""Cross-batch caches: repeat batches must skip the device; failures must
+never be cached; keys must commit to the spent outputs.
+
+Reference contract: `script/sigcache.cpp:22-122` (salted, success-only)
+and `validation.cpp:1529-1536` (script cache keyed on wtxid+flags)."""
+
+from conftest import *  # noqa: F401,F403 (env setup)
+
+from bitcoinconsensus_tpu.core.flags import VERIFY_ALL_LIBCONSENSUS
+from bitcoinconsensus_tpu.crypto.jax_backend import TpuSecpVerifier, default_verifier
+from bitcoinconsensus_tpu.models.batch import BatchItem, verify_batch
+from bitcoinconsensus_tpu.models.sigcache import ScriptExecutionCache, SigCache
+from test_batch import make_p2wpkh_spend
+
+
+class CountingVerifier(TpuSecpVerifier):
+    """Counts lanes actually dispatched; shares the process jit cache."""
+
+    def __init__(self):
+        super().__init__()
+        self.dispatched = 0
+
+    def verify_checks(self, checks):
+        self.dispatched += len(checks)
+        return default_verifier().verify_checks(checks)
+
+
+def _items(seeds, corrupt=()):
+    items = []
+    for s in seeds:
+        txb, spk, amt = make_p2wpkh_spend(s, corrupt=s in corrupt)
+        items.append(
+            BatchItem(txb, 0, VERIFY_ALL_LIBCONSENSUS, spent_output_script=spk, amount=amt)
+        )
+    return items
+
+
+def test_repeat_batch_skips_device_entirely():
+    v = CountingVerifier()
+    sig, script = SigCache(), ScriptExecutionCache()
+    items = _items(["c1", "c2", "c3"])
+    res1 = verify_batch(items, verifier=v, sig_cache=sig, script_cache=script)
+    assert all(r.ok for r in res1)
+    first = v.dispatched
+    assert first == 3
+    # Same batch again: script-cache hits -> no interpretation, no device.
+    res2 = verify_batch(items, verifier=v, sig_cache=sig, script_cache=script)
+    assert all(r.ok for r in res2)
+    assert v.dispatched == first
+    assert script.hits >= 3
+
+
+def test_sig_cache_alone_skips_dispatch():
+    v = CountingVerifier()
+    sig = SigCache()
+    items = _items(["s1", "s2"])
+    verify_batch(items, verifier=v, sig_cache=sig, script_cache=ScriptExecutionCache())
+    assert v.dispatched == 2
+    # Fresh script cache: interpretation re-runs, but every curve check is
+    # sig-cache-known -> zero device lanes.
+    verify_batch(items, verifier=v, sig_cache=sig, script_cache=ScriptExecutionCache())
+    assert v.dispatched == 2
+    assert sig.hits >= 2
+
+
+def test_failures_never_cached():
+    v = CountingVerifier()
+    sig, script = SigCache(), ScriptExecutionCache()
+    items = _items(["f1"], corrupt={"f1"})
+    r1 = verify_batch(items, verifier=v, sig_cache=sig, script_cache=script)
+    assert not r1[0].ok
+    d1 = v.dispatched
+    r2 = verify_batch(items, verifier=v, sig_cache=sig, script_cache=script)
+    assert not r2[0].ok
+    assert v.dispatched > d1  # re-dispatched: failure was not cached
+    assert len(sig) == 0 and len(script) == 0
+
+
+def test_script_cache_key_commits_to_spent_outputs():
+    txb, spk, amt = make_p2wpkh_spend("k1")
+    good = BatchItem(txb, 0, VERIFY_ALL_LIBCONSENSUS, spent_output_script=spk, amount=amt)
+    # Same tx, wrong amount: BIP143 sighash differs -> invalid.
+    bad = BatchItem(
+        txb, 0, VERIFY_ALL_LIBCONSENSUS, spent_output_script=spk, amount=amt + 1
+    )
+    sig, script = SigCache(), ScriptExecutionCache()
+    v = CountingVerifier()
+    assert verify_batch([good], verifier=v, sig_cache=sig, script_cache=script)[0].ok
+    # The cached success for `good` must NOT leak to `bad`.
+    assert not verify_batch([bad], verifier=v, sig_cache=sig, script_cache=script)[0].ok
+
+
+def test_lru_bound():
+    sig = SigCache(max_entries=4)
+    for i in range(10):
+        sig.add_check("ecdsa", (b"pk%d" % i, b"sig", b"m"))
+    assert len(sig) == 4
+    assert sig.contains_check("ecdsa", (b"pk9", b"sig", b"m"))
+    assert not sig.contains_check("ecdsa", (b"pk0", b"sig", b"m"))
