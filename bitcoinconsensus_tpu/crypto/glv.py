@@ -1,0 +1,42 @@
+"""Host-side GLV scalar decomposition for the verify kernel.
+
+Splits a scalar k (mod n) as k = s1·|k1| + lambda·s2·|k2| with
+|k1|, |k2| < 2^128, following the lattice-basis construction the
+reference documents and implements in
+`secp256k1/src/scalar_impl.h:60-178` (secp256k1_scalar_split_lambda):
+c1 = round(b2·k/n), c2 = round(-b1·k/n), k2 = -(c1·b1 + c2·b2),
+k1 = k - k2·lambda. Host Python ints make the rounding exact, so the
+g1/g2 384-bit estimate machinery of the reference is unnecessary.
+
+The device half of the scheme lives in `ops/curve.double_scalar_mult_glv`.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+from ..ops.curve import LAMBDA
+from .secp_host import N
+
+__all__ = ["split_lambda", "LAMBDA"]
+
+_B1 = -0xE4437ED6010E88286F547FA90ABFE4C3
+_B2 = 0x3086D221A7D46BCDE86C90E49284EB15
+
+
+def split_lambda(k: int) -> Tuple[int, int, int, int]:
+    """k (mod n) -> (abs_k1, neg1, abs_k2, neg2) with abs_ki < 2^128 and
+    s1·abs_k1 + lambda·s2·abs_k2 ≡ k (mod n), si = -1 if negi else 1."""
+    k %= N
+    c1 = (_B2 * k + N // 2) // N
+    c2 = (-_B1 * k + N // 2) // N
+    k2 = -(c1 * _B1 + c2 * _B2)
+    k1 = k - k2 * LAMBDA
+    k1 %= N
+    k2 %= N
+    neg1 = k1 > N - k1
+    neg2 = k2 > N - k2
+    a1 = N - k1 if neg1 else k1
+    a2 = N - k2 if neg2 else k2
+    assert a1 < 1 << 128 and a2 < 1 << 128, (k, a1, a2)
+    return a1, int(neg1), a2, int(neg2)

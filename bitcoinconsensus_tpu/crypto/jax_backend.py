@@ -61,7 +61,14 @@ from ..ops.limbs import (
     fe_sub,
     int_to_limbs,
 )
-from ..ops.curve import G_X, G_Y, double_scalar_mult, jacobian_to_affine
+from ..ops.curve import (
+    G_X,
+    G_Y,
+    _digits128,
+    double_scalar_mult_glv,
+    jacobian_to_affine,
+)
+from .glv import split_lambda
 from .secp_host import N, parse_der_lax
 
 __all__ = ["SigCheck", "TpuSecpVerifier", "default_verifier"]
@@ -112,25 +119,36 @@ def _batch_inv_mod_n(vals: List[int]) -> List[int]:
 class _Lane:
     """Host-parsed check, ready for byte packing.
 
-    a, b: scalars (< n); px: the point's x coordinate; want_odd: parity of
-    the y lift (valid pubkeys always resolve to a parity — uncompressed
-    keys are curve-checked on host, so y is recomputable from its parity);
-    t1: the x-coordinate target; has_t2 marks the ECDSA r+n secondary
-    target (only when r + n < p); parity_req constrains R.y parity
-    (-1 don't care / 0 even / 1 odd).
+    a: fixed-base scalar (< n); the variable-base scalar b ships GLV-split
+    as (|b1|, |b2|, neg1, neg2) with |bi| < 2^128 (`crypto/glv.py` —
+    halves the doubling count on device). px: the point's x coordinate;
+    want_odd: parity of the y lift (valid pubkeys always resolve to a
+    parity — uncompressed keys are curve-checked on host, so y is
+    recomputable from its parity); t1: the x-coordinate target; has_t2
+    marks the ECDSA r+n secondary target (only when r + n < p);
+    parity_req constrains R.y parity (-1 don't care / 0 even / 1 odd).
     """
 
-    __slots__ = ("valid", "a", "b", "px", "want_odd", "t1", "has_t2", "parity")
+    __slots__ = (
+        "valid", "a", "b1", "b2", "neg1", "neg2", "px", "want_odd", "t1",
+        "has_t2", "parity",
+    )
 
     def __init__(self):
         self.valid = False
         self.a = 0
-        self.b = 0
+        self.b1 = 0
+        self.b2 = 0
+        self.neg1 = 0
+        self.neg2 = 0
         self.px = G_X
         self.want_odd = 0
         self.t1 = 0
         self.has_t2 = 0
         self.parity = -1
+
+    def set_b(self, b: int) -> None:
+        self.b1, self.neg1, self.b2, self.neg2 = split_lambda(b)
 
 
 def _host_parse_pubkey(lane: _Lane, pubkey: bytes) -> bool:
@@ -199,7 +217,7 @@ def _prep_schnorr(lane: _Lane, pubkey32: bytes, sig64: bytes, msg32: bytes):
     lane.px = px
     lane.want_odd = 0  # BIP340 lift_x: even y; device checks existence
     lane.a = s
-    lane.b = (N - e) % N  # (n-e)·P = -e·P
+    lane.set_b((N - e) % N)  # (n-e)·P = -e·P
     lane.t1 = r
     lane.parity = 0  # require even R.y
     lane.valid = True
@@ -219,7 +237,7 @@ def _prep_tweak(lane: _Lane, tweaked32: bytes, parity: int, internal32: bytes,
     lane.px = px
     lane.want_odd = 0  # x-only internal key: even-y lift, device-checked
     lane.a = t
-    lane.b = 1
+    lane.set_b(1)
     # tx >= p can never equal a canonical x coordinate; the raw compare
     # below is False for such lanes with no sentinel machinery.
     lane.t1 = tx
@@ -231,15 +249,18 @@ _SEVEN_LIMBS = int_to_limbs(7)
 _N_LIMBS = int_to_limbs(N)
 
 
-def _verify_kernel(fields, want_odd, parity_req, has_t2, valid):
+def _verify_kernel(fields, want_odd, parity_req, has_t2, neg1, neg2, valid):
     """Device side of the mixed verify batch.
 
-    fields: (B, 4, 32) uint8 — little-endian (a, b, px, t1) per lane.
-    Unpacks to limb-major (20, B), lifts P's y from (px, want_odd) via
-    fe_sqrt, runs R = a·G + b·P, and accepts per lane:
-    R.x == t1, or (has_t2) R.x == t1 + n, with optional R.y parity."""
+    fields: (B, 4, 32) uint8 — little-endian (a, |b1|‖|b2|, px, t1) per
+    lane (the variable-base scalar arrives GLV-split: two 16-byte halves
+    sharing field 1, signs in neg1/neg2). Unpacks to limb-major (20, B),
+    lifts P's y from (px, want_odd) via fe_sqrt, runs
+    R = a·G + (±b1 ± lambda·b2)·P with the GLV schedule, and accepts per
+    lane: R.x == t1, or (has_t2) R.x == t1 + n, with optional R.y parity."""
     a = bytes_to_limbs(fields[:, 0])
-    b = bytes_to_limbs(fields[:, 1])
+    b1 = bytes_to_limbs(fields[:, 1, :16], nlimb=10)
+    b2 = bytes_to_limbs(fields[:, 1, 16:], nlimb=10)
     px = bytes_to_limbs(fields[:, 2])
     t1 = bytes_to_limbs(fields[:, 3])
 
@@ -255,7 +276,9 @@ def _verify_kernel(fields, want_odd, parity_req, has_t2, valid):
     py = jnp.where(flip[None], yneg, ycand)
     valid = valid & sq_ok
 
-    X, Y, Z = double_scalar_mult(a, b, px, py)
+    X, Y, Z = double_scalar_mult_glv(
+        a, _digits128(b1), _digits128(b2), neg1 == 1, neg2 == 1, px, py
+    )
     x, y, inf = jacobian_to_affine(X, Y, Z)
 
     nl = jnp.broadcast_to(
@@ -273,12 +296,31 @@ def _verify_kernel(fields, want_odd, parity_req, has_t2, valid):
 class TpuSecpVerifier:
     """Batched verifier; pads to power-of-two batch shapes and jits once per
     shape (persistent XLA cache across processes). Large batches are split
-    into `chunk` -lane dispatches pipelined back-to-back."""
+    into `chunk` -lane dispatches pipelined back-to-back.
+
+    Two device backends, bit-identical results (tests/test_pallas_kernel.py):
+    - XLA-traced kernel (`_verify_kernel`) — every platform; the only
+      choice for small batches and the CPU mesh tests.
+    - Pallas mega-kernel (`ops/pallas_kernel.verify_tiles`) — TPU batches
+      of >= LANE_TILE lanes; the whole scalar-mult pipeline VMEM-resident.
+    Selection is automatic (TPU + large batch); BITCOINCONSENSUS_TPU_PALLAS
+    =0/1 forces it off/on.
+    """
 
     def __init__(self, min_batch: int = 8, chunk: int = 1 << 13):
         self._kernel = jax.jit(_verify_kernel)
         self._min_batch = min_batch
         self._chunk = chunk
+        env = os.environ.get("BITCOINCONSENSUS_TPU_PALLAS", "")
+        if env in ("0", "off"):
+            self._use_pallas = False
+        elif env in ("1", "on"):
+            self._use_pallas = True
+        else:
+            try:
+                self._use_pallas = jax.default_backend() == "tpu"
+            except Exception:  # pragma: no cover
+                self._use_pallas = False
         self.phases = Phases()  # host_prep / pack / dispatch / sync
 
     def _pad(self, n: int) -> int:
@@ -303,7 +345,7 @@ class TpuSecpVerifier:
             sinvs = _batch_inv_mod_n([s for _, _, s, _ in ecdsa_pending])
             for (lane, r, _s, m), sinv in zip(ecdsa_pending, sinvs):
                 lane.a = m * sinv % N  # u1
-                lane.b = r * sinv % N  # u2
+                lane.set_b(r * sinv % N)  # u2
         return lanes
 
     def verify_checks(self, checks: Sequence[SigCheck]) -> np.ndarray:
@@ -337,7 +379,8 @@ class TpuSecpVerifier:
         pos = 0
         for lane in lanes:
             raw[pos : pos + 32] = lane.a.to_bytes(32, "little")
-            raw[pos + 32 : pos + 64] = lane.b.to_bytes(32, "little")
+            raw[pos + 32 : pos + 48] = lane.b1.to_bytes(16, "little")
+            raw[pos + 48 : pos + 64] = lane.b2.to_bytes(16, "little")
             raw[pos + 64 : pos + 96] = lane.px.to_bytes(32, "little")
             raw[pos + 96 : pos + 128] = lane.t1.to_bytes(32, "little")
             pos += 128
@@ -350,14 +393,23 @@ class TpuSecpVerifier:
         want_odd = flag(lambda l: l.want_odd, 0)
         parity = flag(lambda l: l.parity, -1)
         has_t2 = flag(lambda l: l.has_t2, 0)
+        neg1 = flag(lambda l: l.neg1, 0)
+        neg2 = flag(lambda l: l.neg2, 0)
         valid = np.zeros(size, dtype=bool)
         valid[:n] = [lane.valid for lane in lanes]
-        return fields, want_odd, parity, has_t2, valid
+        return fields, want_odd, parity, has_t2, neg1, neg2, valid
 
     def _run_kernel(self, args: Tuple, n: int):
         """Dispatch seam: subclasses (mesh sharding) override to add a live
         mask / collective verdict. `n` is the count of real (unpadded)
         lanes. Returns the (async) device result."""
+        if self._use_pallas:
+            # Deferred import keeps CPU-only paths light; LANE_TILE is the
+            # kernel's own tile so the guard cannot drift from its assert.
+            from ..ops.pallas_kernel import LANE_TILE, verify_tiles
+
+            if args[0].shape[0] % LANE_TILE == 0:
+                return verify_tiles(*args)
         return self._kernel(*args)
 
     # Convenience single-check wrappers (used by tests/differential fuzzing).

@@ -56,10 +56,14 @@ from .limbs import (
 __all__ = [
     "G_X",
     "G_Y",
+    "BETA",
+    "LAMBDA",
+    "GLV_WINDOWS",
     "jacobian_double",
     "jacobian_madd_complete",
     "jacobian_add_complete",
     "double_scalar_mult",
+    "double_scalar_mult_glv",
     "double_scalar_mult_bits",
     "jacobian_to_affine",
     "scalar_bits",
@@ -68,8 +72,18 @@ __all__ = [
 G_X = 0x79BE667EF9DCBBAC55A06295CE870B07029BFCDB2DCE28D959F2815B16F81798
 G_Y = 0x483ADA7726A3C4655DA4FBFC0E1108A8FD17B448A68554199C47D08FFB10D4B8
 
+# GLV endomorphism: beta^3 = 1 mod p, lambda^3 = 1 mod n, and
+# lambda*(x, y) = (beta*x, y) (secp256k1/src/scalar_impl.h:60-112,
+# field beta at secp256k1.c / util docs). The verify kernel splits the
+# variable-base scalar b = b1 + lambda*b2 with |b1|,|b2| < 2^128
+# (host-side, `crypto/glv.py`) and runs 32 4-bit windows instead of 64 —
+# halving the doubling count, the dominant cost of the scalar mult.
+BETA = 0x7AE96A2B657C07106E64479EAC3434E99CF0497512F58995C1396C28719501EE
+LAMBDA = 0x5363AD4CC05C30E0A5261C028812645A122E22EA20816678DF02967C1B23BD72
+
 _GX_LIMBS = int_to_limbs(G_X)
 _GY_LIMBS = int_to_limbs(G_Y)
+_BETA_LIMBS = int_to_limbs(BETA)
 _ONE = int_to_limbs(1)
 
 NBITS = NLIMB * RADIX  # 260 bit positions per scalar (top 4 always zero)
@@ -80,8 +94,13 @@ G_WINDOW_BITS = 8
 
 
 def _col(vec: np.ndarray, like):
-    """Constant limb vector -> (20, 1, ..., 1) broadcastable column."""
-    return jnp.asarray(vec).reshape((NLIMB,) + (1,) * (like.ndim - 1))
+    """Constant limb vector -> (20, 1, ..., 1) broadcastable column.
+
+    Routed through `limb_const` so pallas kernels resolve it to a
+    constant-table input instead of a captured jnp constant."""
+    from .limbs import limb_const
+
+    return limb_const(vec).reshape((NLIMB,) + (1,) * (like.ndim - 1))
 
 
 def jacobian_double(X, Y, Z):
@@ -299,6 +318,73 @@ def double_scalar_mult(a, b, px, py):
     R = lax.fori_loop(0, P_WINDOWS, body, _inf_like(px))
     RG = _fixed_base_mult(digits_a)
     # Join halves. RG is infinite iff a had no nonzero digit.
+    rg_inf = jnp.all(digits_a == 0, axis=0)
+    return jacobian_add_complete(*R, *RG, rg_inf)
+
+
+GLV_WINDOWS = 32  # 4-bit windows over the 128-bit split halves
+
+
+def _digits128(limbs10, count: int = GLV_WINDOWS, width: int = P_WINDOW_BITS):
+    """(10, ...) limb vector of a < 2^130 value -> (count, ...) 4-bit
+    window digits, LSB first (only bits 0..count*width-1 are consumed)."""
+    shifts = jnp.arange(RADIX, dtype=jnp.int32).reshape(
+        (1, RADIX) + (1,) * (limbs10.ndim - 1)
+    )
+    bits = ((limbs10[:, None] >> shifts) & 1).reshape(
+        (10 * RADIX,) + limbs10.shape[1:]
+    )[: count * width]
+    b = bits.reshape((count, width) + limbs10.shape[1:])
+    weights = jnp.asarray([1 << i for i in range(width)], dtype=jnp.int32)
+    weights = weights.reshape((1, width) + (1,) * (limbs10.ndim - 1))
+    return jnp.sum(b * weights, axis=1)
+
+
+def double_scalar_mult_glv(a, db1, db2, neg1, neg2, px, py):
+    """R = a·G + (±b1 + lambda·(±b2))·P with the GLV-split schedule.
+
+    `a`: (20, ...) scalar limbs (reduced mod n). `db1`, `db2`:
+    (32, ...) 4-bit window digits of |b1|, |b2| < 2^128. `neg1`, `neg2`:
+    (...,) bool — negate the respective half (the split yields signed
+    halves; -P = (x, -y)). `px`, `py`: affine P, never infinity.
+
+    Schedule per lane: 14 madds (shared table) + 32x(4 doublings + 2
+    complete adds + 1 beta-mul + y-negates) + 32 G madds + join — the
+    endomorphism halves the 256 doublings of the non-GLV ladder
+    (reference precedent: secp256k1_scalar_split_lambda + ecmult's
+    wnaf_lam track, ecmult_impl.h:446-559 with USE_ENDOMORPHISM).
+    """
+    digits_a = _digits(a, G_WINDOW_BITS, G_WINDOWS)
+
+    TX, TY, TZ = _p_table(px, py)
+    beta = jnp.broadcast_to(_col(_BETA_LIMBS, px), px.shape).astype(px.dtype)
+    k16 = jnp.arange(16, dtype=jnp.int32).reshape((16,) + (1,) * px.ndim)
+    n1 = neg1[None]
+    n2 = neg2[None]
+
+    def body(i, R):
+        w = GLV_WINDOWS - 1 - i
+        R = jacobian_double(*R)
+        R = jacobian_double(*R)
+        R = jacobian_double(*R)
+        R = jacobian_double(*R)
+        d1 = db1[w]
+        oh = (d1[None] == k16).astype(jnp.int32)
+        sx = jnp.sum(TX * oh, axis=0)
+        sy = jnp.sum(TY * oh, axis=0)
+        sz = jnp.sum(TZ * oh, axis=0)
+        sy = jnp.where(n1, fe_sub(jnp.zeros_like(sy), sy), sy)
+        R = jacobian_add_complete(*R, sx, sy, sz, d1 == 0)
+        d2 = db2[w]
+        oh = (d2[None] == k16).astype(jnp.int32)
+        sx = fe_mul(jnp.sum(TX * oh, axis=0), beta)  # lambda*(x,y)=(bx,y)
+        sy = jnp.sum(TY * oh, axis=0)
+        sz = jnp.sum(TZ * oh, axis=0)
+        sy = jnp.where(n2, fe_sub(jnp.zeros_like(sy), sy), sy)
+        return jacobian_add_complete(*R, sx, sy, sz, d2 == 0)
+
+    R = lax.fori_loop(0, GLV_WINDOWS, body, _inf_like(px))
+    RG = _fixed_base_mult(digits_a)
     rg_inf = jnp.all(digits_a == 0, axis=0)
     return jacobian_add_complete(*R, *RG, rg_inf)
 

@@ -43,6 +43,7 @@ Spec source: the reference's field semantics (`secp256k1/src/field_*_impl.h`)
 
 from __future__ import annotations
 
+import threading
 from typing import List, Sequence, Tuple
 
 import numpy as np
@@ -136,20 +137,51 @@ _P_LIMBS = int_to_limbs(P_INT)
 
 Bounds = List[int]
 
+# Constant provider hook. Pallas kernels cannot capture array constants
+# (they must arrive as kernel inputs), so the pallas wrapper installs a
+# provider that resolves well-known (20,) limb vectors to rows of a
+# constant-table input; the default inlines them as jnp constants (XLA).
+# Thread-LOCAL: tracing runs on the calling thread, and a concurrent
+# XLA trace on another thread must not see a Pallas trace's provider
+# (concurrent verify_batch is part of the documented thread contract).
+_CONST_PROVIDER = threading.local()
 
-def bytes_to_limbs(u8):
-    """Device-side unpack: (..., 32) uint8 little-endian 256-bit values ->
-    limb-major (20, ...) int32.
+
+def limb_const(arr: np.ndarray):
+    provider = getattr(_CONST_PROVIDER, "fn", None)
+    if provider is not None:
+        out = provider(arr)
+        if out is not None:
+            return out
+    return jnp.asarray(arr)
+
+
+def set_const_provider(fn):
+    """Install (or clear, with None) this thread's provider; returns the
+    previous one so callers can restore it (used by ops/pallas_kernel.py)."""
+    prev = getattr(_CONST_PROVIDER, "fn", None)
+    _CONST_PROVIDER.fn = fn
+    return prev
+
+
+def bytes_to_limbs(u8, nlimb: int = NLIMB):
+    """Device-side unpack: (..., K) uint8 little-endian values -> limb-major
+    (nlimb, ...) int32 (K*8 <= nlimb*RADIX; default 32 bytes -> 20 limbs).
 
     Transfers over the host->device link are the scarce resource (32 bytes
     per field instead of 80 bytes of pre-split limbs); the unpack is a
     handful of static gathers + shifts, so it runs where compute is cheap.
     """
+    nbytes = u8.shape[-1]
+    assert nbytes * 8 <= nlimb * RADIX
     x = u8.astype(jnp.int32)
-    pad = jnp.zeros_like(x[..., :1])
-    x = jnp.concatenate([x, pad], axis=-1)  # (..., 33): limb 19 spans 2 bytes
+    # Top limb windows may span past the last byte: zero-pad.
+    pad_n = (RADIX * (nlimb - 1) >> 3) + 3 - nbytes
+    if pad_n > 0:
+        pad = jnp.zeros(x.shape[:-1] + (pad_n,), dtype=x.dtype)
+        x = jnp.concatenate([x, pad], axis=-1)
     limbs = []
-    for i in range(NLIMB):
+    for i in range(nlimb):
         bitpos = RADIX * i
         k, sh = bitpos >> 3, bitpos & 7
         window = x[..., k] | (x[..., k + 1] << 8) | (x[..., k + 2] << 16)
@@ -159,6 +191,13 @@ def bytes_to_limbs(u8):
 
 def _zeros_rows(x, n: int):
     return jnp.zeros((n,) + x.shape[1:], dtype=x.dtype)
+
+
+def _cat_rows(parts):
+    """Concatenate along the limb axis, dropping zero-row operands —
+    Mosaic (pallas) rejects zero-sized vectors that XLA tolerates."""
+    parts = [p for p in parts if p.shape[0] != 0]
+    return parts[0] if len(parts) == 1 else jnp.concatenate(parts, axis=0)
 
 
 def _pass(x, bounds: Bounds) -> Tuple[jnp.ndarray, Bounds]:
@@ -201,12 +240,12 @@ def _fold_high(x, bounds: Bounds) -> Tuple[jnp.ndarray, Bounds]:
     out_len = max(NLIMB, n_hi + len(_FOLD260) - 1)
     lo, hi = x[:NLIMB], x[NLIMB:]
     pad = out_len - NLIMB
-    acc = jnp.concatenate([lo, _zeros_rows(x, pad)], axis=0) if pad else lo
+    acc = _cat_rows([lo, _zeros_rows(x, pad)]) if pad else lo
     b2 = bounds[:NLIMB] + [0] * pad
     for j, f in enumerate(_FOLD260):
         zl = _zeros_rows(x, j)
         zr = _zeros_rows(x, out_len - j - n_hi)
-        acc = acc + jnp.concatenate([zl, hi * f, zr], axis=0)
+        acc = acc + _cat_rows([zl, hi * f, zr])
         for i in range(n_hi):
             b2[i + j] += bounds[NLIMB + i] * f
             assert b2[i + j] < 2**31
@@ -269,7 +308,7 @@ _SUB_BOUNDS = [int(d) + w for d, w in zip(_SUB_BIAS, W2)]
 
 def fe_sub(a, b):
     """a - b mod p (weak in/out): a + 32p(in >=W2-limb form) - b >= 0."""
-    bias = jnp.asarray(_SUB_BIAS).reshape((NLIMB,) + (1,) * (a.ndim - 1))
+    bias = limb_const(_SUB_BIAS).reshape((NLIMB,) + (1,) * (a.ndim - 1))
     return _settle(a + bias - b, list(_SUB_BOUNDS))
 
 
@@ -286,9 +325,8 @@ def _conv_rows(a, b, bw: Bounds, aw: Bounds):
     bounds = [0] * out_len
     for i in range(NLIMB):
         row = a[i] * b  # (NLIMB, ...) scaled by one limb of a
-        padded = jnp.concatenate(
-            [_zeros_rows(b, i), row, _zeros_rows(b, out_len - i - NLIMB)],
-            axis=0,
+        padded = _cat_rows(
+            [_zeros_rows(b, i), row, _zeros_rows(b, out_len - i - NLIMB)]
         )
         acc = padded if acc is None else acc + padded
         for j in range(NLIMB):
@@ -314,12 +352,12 @@ def fe_sqr(a):
     for i in range(NLIMB):
         # diagonal a_i^2 once + doubled cross terms a_i * a_j (j > i).
         hi = NLIMB - i - 1
-        row = jnp.concatenate(
-            [a[i : i + 1] * a[i : i + 1], a[i] * a2[i + 1 :]], axis=0
-        )
-        padded = jnp.concatenate(
-            [_zeros_rows(a, 2 * i), row, _zeros_rows(a, out_len - 2 * i - 1 - hi)],
-            axis=0,
+        diag = a[i : i + 1] * a[i : i + 1]
+        # hi == 0 (last limb): the cross-term slice would be zero-size,
+        # which Mosaic rejects — emit the diagonal alone.
+        row = _cat_rows([diag, a[i] * a2[i + 1 :]]) if hi else diag
+        padded = _cat_rows(
+            [_zeros_rows(a, 2 * i), row, _zeros_rows(a, out_len - 2 * i - 1 - hi)]
         )
         acc = padded if acc is None else acc + padded
         bounds[2 * i] += W2[i] * W2[i]
@@ -396,7 +434,7 @@ def fe_canon(a, bounds: Bounds = None):
     # One conditional subtract-p via borrow lookahead: d = e - p limbwise;
     # borrow-in b satisfies the same prefix recurrence with
     # g = (d < 0), pr = (d == 0) on the negated difference domain.
-    p = jnp.asarray(_P_LIMBS).reshape((NLIMB,) + (1,) * (a.ndim - 1))
+    p = limb_const(_P_LIMBS).reshape((NLIMB,) + (1,) * (a.ndim - 1))
     d = e - p
     g = (d < 0).astype(jnp.int32)
     pr = (d == 0).astype(jnp.int32)  # zero diff propagates an incoming borrow
@@ -417,7 +455,7 @@ def fe_canon(a, bounds: Bounds = None):
 def fe_is_zero(a, bounds: Bounds = None):
     """a ≡ 0 mod p? Returns (...,) bool (batch shape without limb axis)."""
     e = _exact_lt_2p(a, list(W2) if bounds is None else list(bounds))
-    p = jnp.asarray(_P_LIMBS).reshape((NLIMB,) + (1,) * (a.ndim - 1))
+    p = limb_const(_P_LIMBS).reshape((NLIMB,) + (1,) * (a.ndim - 1))
     return jnp.all(e == 0, axis=0) | jnp.all(e == p, axis=0)
 
 

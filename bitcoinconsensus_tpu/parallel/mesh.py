@@ -69,13 +69,15 @@ def make_sharded_step(mesh: Mesh):
         reduce_all, mesh=mesh, in_specs=(P(axis), P(axis)), out_specs=P()
     )
 
-    def step(fields, want_odd, parity_req, has_t2, valid, live):
-        per_lane = _verify_kernel(fields, want_odd, parity_req, has_t2, valid)
+    def step(fields, want_odd, parity_req, has_t2, neg1, neg2, valid, live):
+        per_lane = _verify_kernel(
+            fields, want_odd, parity_req, has_t2, neg1, neg2, valid
+        )
         return per_lane, reduce_sharded(per_lane, live)
 
     return jax.jit(
         step,
-        in_shardings=(fields_sharding,) + (flat_sharding,) * 5,
+        in_shardings=(fields_sharding,) + (flat_sharding,) * 7,
         out_shardings=(flat_sharding, replicated),
     )
 

@@ -25,7 +25,7 @@ import time
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
 from bitcoinconsensus_tpu.core.flags import VERIFY_ALL_LIBCONSENSUS
-from bitcoinconsensus_tpu.utils.blockgen import Wallet, build_spend_tx, make_funded_view
+from bitcoinconsensus_tpu.utils.blockgen import build_spend_tx, make_funded_view
 from bitcoinconsensus_tpu.utils.refbridge import load_reference_lib
 
 # The crate's own P2PKH end-to-end vector (src/lib.rs:225-229), shared

@@ -28,7 +28,6 @@ from bitcoinconsensus_tpu.core.tx import COIN, OutPoint, Tx, TxIn, TxOut
 from bitcoinconsensus_tpu.models.validate import (
     COINBASE_MATURITY,
     Coin,
-    CoinsView,
     connect_block,
     get_block_subsidy,
     get_transaction_sigop_cost,

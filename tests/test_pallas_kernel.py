@@ -1,0 +1,53 @@
+"""Pallas verify kernel vs the XLA-traced kernel: bit-equality.
+
+The pallas path (`ops/pallas_kernel.py`) is the TPU production backend;
+the XLA kernel is the reference semantics (itself oracle-tested against
+`crypto/secp_host.py`). On CPU the pallas kernel runs in interpreter
+mode — slow, so the batch is small and the case mix is adversarial:
+valid ECDSA/Schnorr/tweak lanes, corrupted targets, invalid pubkeys
+(non-residue x), structurally-invalid lanes, and r+n secondary targets.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from conftest import *  # noqa: F401,F403 (env setup)
+
+RUN = os.environ.get("PALLAS_INTERPRET_TESTS", "1") != "0"
+
+pytestmark = pytest.mark.skipif(
+    not RUN, reason="pallas interpreter equality disabled (PALLAS_INTERPRET_TESTS=0)"
+)
+
+
+def test_pallas_matches_xla_kernel():
+    import __graft_entry__ as ge
+    from bitcoinconsensus_tpu.crypto.jax_backend import _verify_kernel
+    from bitcoinconsensus_tpu.ops.pallas_kernel import verify_tiles
+
+    fields, want_odd, parity, has_t2, neg1, neg2, valid = ge._example_arrays(16)
+    fields = np.array(fields)
+    want_odd = np.array(want_odd)
+    valid = np.array(valid)
+    neg1 = np.array(neg1)
+
+    fields[3, 3, 0] ^= 1  # corrupt lane 3's target -> must fail
+    valid[5] = False  # structurally invalid lane
+    fields[7, 2, 0] ^= 1  # perturb lane 7's pubkey x (likely non-residue)
+    want_odd[2] ^= 1  # wrong y parity for lane 2's pubkey -> wrong R
+    neg1[4] ^= 1  # flip a GLV half sign -> wrong R for lane 4
+
+    want = np.asarray(
+        _verify_kernel(fields, want_odd, parity, has_t2, neg1, neg2, valid)
+    )
+    got = np.asarray(
+        verify_tiles(
+            fields, want_odd, parity, has_t2, neg1, neg2, valid,
+            tile=16, interpret=True,
+        )
+    )
+    assert (got == want).all(), (got, want)
+    assert not want[3] and not want[5] and not want[2] and not want[4]
+    assert want[0] and want[1]
