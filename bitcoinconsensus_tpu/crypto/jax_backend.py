@@ -18,9 +18,10 @@ device sits behind a narrow tunnel; one mixed batch is ~4k field muls per
 lane on a VPU that does them in microseconds). Hence:
 
 - **Byte-packed transfers**: each check ships as 4 x 32-byte fields
-  (a, b, pubkey-x, target) + 4 flag bytes — 132 B/lane instead of ~500 B
-  of pre-split limbs. Limb splitting, y-lifting (fe_sqrt), and the r+n
-  secondary target all happen on device.
+  (a, GLV-split |b1|‖|b2|, pubkey-x, target) + 6 flag ints — ~150 B/lane
+  instead of ~500 B of pre-split limbs. Limb splitting, window-digit
+  extraction, y-lifting (fe_sqrt), and the r+n secondary target all
+  happen on device.
 - **Pipelined chunk dispatch**: large batches go out in chunks whose
   transfers/compute overlap the host-side prep of the next chunk (JAX
   async dispatch); the per-roundtrip sync cost is paid once.
@@ -64,6 +65,8 @@ from ..ops.limbs import (
 from ..ops.curve import (
     G_X,
     G_Y,
+    _GX_LIMBS,
+    _GY_LIMBS,
     _digits128,
     double_scalar_mult_glv,
     jacobian_to_affine,
@@ -275,11 +278,25 @@ def _verify_kernel(fields, want_odd, parity_req, has_t2, neg1, neg2, valid):
     flip = odd != (want_odd == 1)
     py = jnp.where(flip[None], yneg, ycand)
     valid = valid & sq_ok
+    # Sanitize: invalid lanes (non-residue x — off-curve garbage) are
+    # replaced by the generator so EVERY lane runs on-curve group math.
+    # This keeps the explicitly-tracked infinity masks sound (off-curve
+    # orbits obey no group law and could hit Z ≡ 0 unflagged, which
+    # would zero the cross-lane batch-inversion product); the verdicts
+    # of these lanes are masked by `valid` regardless.
+    gxb = jnp.broadcast_to(
+        jnp.asarray(_GX_LIMBS).reshape(NLIMB, 1), px.shape
+    ).astype(px.dtype)
+    gyb = jnp.broadcast_to(
+        jnp.asarray(_GY_LIMBS).reshape(NLIMB, 1), px.shape
+    ).astype(px.dtype)
+    px = jnp.where(valid[None], px, gxb)
+    py = jnp.where(valid[None], py, gyb)
 
-    X, Y, Z = double_scalar_mult_glv(
+    X, Y, Z, r_inf = double_scalar_mult_glv(
         a, _digits128(b1), _digits128(b2), neg1 == 1, neg2 == 1, px, py
     )
-    x, y, inf = jacobian_to_affine(X, Y, Z)
+    x, y, inf = jacobian_to_affine(X, Y, Z, inf=r_inf)
 
     nl = jnp.broadcast_to(
         jnp.asarray(_N_LIMBS).reshape(NLIMB, 1), t1.shape

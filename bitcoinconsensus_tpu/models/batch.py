@@ -14,12 +14,14 @@ the reference itself provides (`DeferringSignatureChecker`,
 2. All recorded checks from all inputs — deduplicated, the in-batch
    analogue of Core's salted sig cache (`script/sigcache.cpp:22-122`) —
    resolve in one mixed device dispatch (`crypto/jax_backend.py`).
-3. Any input whose optimistic guesses were wrong is re-run synchronously
-   with the exact host checker. This is required because check results feed
-   script control flow (`OP_CHECKSIG` pushes the bool, interpreter.cpp:1097;
-   CHECKMULTISIG's cursor advance, interpreter.cpp:1177-1205; NULLFAIL,
-   interpreter.cpp:365-366). Valid-signature batches — the mainnet common
-   case — never take this path.
+3. Any input whose optimistic guesses were wrong is RE-interpreted with
+   the device results as an oracle; checks discovered by the corrected
+   control flow (CHECKMULTISIG's cursor advance depends on each result,
+   interpreter.cpp:1177-1205; OP_CHECKSIG pushes the bool,
+   interpreter.cpp:1097; NULLFAIL, interpreter.cpp:365-366) go out as
+   further batched dispatches until a fixpoint — e.g. a 2-of-3 multisig
+   whose sigs belong to the lower keys converges in two rounds, all on
+   device. A round cap falls back to the exact host checker.
 
 Batch results are bit-identical to per-input `verify_with_flags` /
 `verify_with_spent_outputs`, including `Error` codes and `ScriptError`s
@@ -82,24 +84,41 @@ class BatchResult:
 
 
 class DeferringSignatureChecker(TransactionSignatureChecker):
-    """Records curve checks and optimistically succeeds; the sighash and all
-    encoding checks still run inline (they are host work by design)."""
+    """Records curve checks and answers from a known-results oracle,
+    optimistically succeeding on unknowns; the sighash and all encoding
+    checks still run inline (they are host work by design).
 
-    def __init__(self, tx, n_in, amount, txdata):
+    With an empty oracle this is the plain optimistic first pass. With
+    device results fed back in, re-interpretation resolves control flow
+    exactly where earlier guesses were wrong — the CHECKMULTISIG cursor
+    (interpreter.cpp:1177-1205) tries sig/key pairs in order, so a 2-of-3
+    whose sigs belong to lower keys discovers the true pairing over a few
+    oracle rounds, each a batched device dispatch instead of host EC math.
+    `unknown` counts oracle misses: zero means the produced verdict is
+    exact."""
+
+    def __init__(self, tx, n_in, amount, txdata, known=None):
         super().__init__(tx, n_in, amount, txdata)
         self.recorded: List[SigCheck] = []
+        self.known = known if known is not None else {}
+        self.unknown = 0
+
+    def _resolve(self, kind: str, data: Tuple) -> bool:
+        res = self.known.get((kind, data))
+        if res is None:
+            self.unknown += 1
+            self.recorded.append(SigCheck(kind, data))
+            return True
+        return res
 
     def verify_ecdsa(self, sig_der: bytes, pubkey: bytes, sighash: bytes) -> bool:
-        self.recorded.append(SigCheck("ecdsa", (pubkey, sig_der, sighash)))
-        return True
+        return self._resolve("ecdsa", (pubkey, sig_der, sighash))
 
     def verify_schnorr(self, sig64: bytes, pubkey32: bytes, sighash: bytes) -> bool:
-        self.recorded.append(SigCheck("schnorr", (pubkey32, sig64, sighash)))
-        return True
+        return self._resolve("schnorr", (pubkey32, sig64, sighash))
 
     def verify_taproot_tweak(self, q: bytes, parity: int, p: bytes, t: bytes) -> bool:
-        self.recorded.append(SigCheck("tweak", (q, parity, p, t)))
-        return True
+        return self._resolve("tweak", (q, parity, p, t))
 
 
 @dataclass
@@ -113,16 +132,33 @@ class _Prepared:
     checks: List[SigCheck] = field(default_factory=list)
 
 
-def _prepare(item: BatchItem, tx_cache: Dict[bytes, Tx]) -> _Prepared:
+def _spent_memo_entry(item: BatchItem, spent_memo: Dict[int, Tuple]):
+    """(List[TxOut], digest) for item.spent_outputs, memoized by the
+    sequence's identity: a 10k-input tx shares ONE conversion + digest
+    across its 10k items instead of an O(n²) per-item pass. Identity
+    keying is safe within one verify_batch call (items hold the refs)."""
+    key = id(item.spent_outputs)
+    ent = spent_memo.get(key)
+    if ent is None:
+        outs = [TxOut(a, s) for a, s in item.spent_outputs]
+        ent = (outs, ScriptExecutionCache.spent_digest(item.spent_outputs))
+        spent_memo[key] = ent
+    return ent
+
+
+def _prepare(
+    item: BatchItem,
+    tx_cache: Dict[bytes, Tx],
+    txdata_cache: Dict[Tuple, PrecomputedTxData],
+    spent_memo: Dict[int, Tuple],
+) -> _Prepared:
     """Transport-level validation; mirrors bitcoinconsensus.cpp:79-101 check
-    order (flags -> deserialize -> index -> size)."""
+    order (flags -> deserialize -> index -> size). PrecomputedTxData is
+    built once per (tx, prevouts-digest) — the validation.cpp:1538-1549
+    one-hash-pass-per-tx shape — and the digest keying means conflicting
+    prevout lists for the same tx can never share a cache entry."""
     prep = _Prepared()
-    spent_outputs = None
-    if item.spent_outputs is not None:
-        allowed = ALL_FLAG_BITS
-        spent_outputs = [TxOut(a, s) for a, s in item.spent_outputs]
-    else:
-        allowed = LIBCONSENSUS_FLAGS
+    allowed = ALL_FLAG_BITS if item.spent_outputs is not None else LIBCONSENSUS_FLAGS
     if item.flags & ~allowed:
         prep.result = BatchResult(False, Error.ERR_INVALID_FLAGS)
         return prep
@@ -141,18 +177,29 @@ def _prepare(item: BatchItem, tx_cache: Dict[bytes, Tx]) -> _Prepared:
         prep.result = BatchResult(False, Error.ERR_TX_DESERIALIZE)
         return prep
 
-    if spent_outputs is not None:
+    if item.spent_outputs is not None:
+        spent_outputs, digest = _spent_memo_entry(item, spent_memo)
         if len(spent_outputs) != len(tx.vin):
             prep.result = BatchResult(False, Error.ERR_TX_INDEX)
             return prep
-        prep.txdata = PrecomputedTxData(tx, spent_outputs)
+        tkey = (id(tx), digest)
+        txdata = txdata_cache.get(tkey)
+        if txdata is None:
+            txdata = PrecomputedTxData(tx, spent_outputs)
+            txdata_cache[tkey] = txdata
+        prep.txdata = txdata
         prep.script_pubkey = spent_outputs[item.input_index].script_pubkey
         prep.amount = spent_outputs[item.input_index].value
     else:
         if item.flags & VERIFY_TAPROOT:
             prep.result = BatchResult(False, Error.ERR_AMOUNT_REQUIRED)
             return prep
-        prep.txdata = PrecomputedTxData(tx)
+        tkey = (id(tx), None)
+        txdata = txdata_cache.get(tkey)
+        if txdata is None:
+            txdata = PrecomputedTxData(tx)
+            txdata_cache[tkey] = txdata
+        prep.txdata = txdata
         prep.script_pubkey = item.spent_output_script or b""
         prep.amount = item.amount
     prep.tx = tx
@@ -182,8 +229,11 @@ def verify_batch(
         script_cache = default_script_cache()
 
     tx_cache: Dict[bytes, Tx] = {}
-    txdata_cache: Dict[int, PrecomputedTxData] = {}
-    preps = [_prepare(item, tx_cache) for item in items]
+    txdata_cache: Dict[Tuple, PrecomputedTxData] = {}
+    spent_memo: Dict[int, Tuple] = {}
+    preps = [
+        _prepare(item, tx_cache, txdata_cache, spent_memo) for item in items
+    ]
 
     # Script-execution cache probe: a hit certifies this exact
     # (wtxid, input, flags, prevouts) succeeded before — skip the
@@ -192,27 +242,17 @@ def verify_batch(
     for idx, (item, prep) in enumerate(zip(items, preps)):
         if prep.result is not None or prep.tx is None:
             continue
-        outs = (
-            item.spent_outputs
-            if item.spent_outputs is not None
-            else [(item.amount, item.spent_output_script or b"")]
-        )
-        digest = ScriptExecutionCache.spent_digest(outs)
+        if item.spent_outputs is not None:
+            digest = _spent_memo_entry(item, spent_memo)[1]
+        else:
+            digest = ScriptExecutionCache.spent_digest(
+                [(item.amount, item.spent_output_script or b"")]
+            )
         spent_digests[idx] = digest
         if script_cache.contains_input(
             prep.tx.wtxid, item.input_index, item.flags, digest
         ):
             prep.result = BatchResult.success()
-    # Share PrecomputedTxData between items of the same tx (one hash pass
-    # per tx, as in validation.cpp:1538-1549).
-    for prep in preps:
-        if prep.tx is not None and prep.txdata is not None:
-            key = id(prep.tx)
-            cached = txdata_cache.get(key)
-            if cached is not None and cached.spent_outputs_ready >= prep.txdata.spent_outputs_ready:
-                prep.txdata = cached
-            else:
-                txdata_cache[key] = prep.txdata
 
     # Phase 1: optimistic interpretation, recording curve checks.
     for item, prep in zip(items, preps):
@@ -233,42 +273,57 @@ def verify_batch(
 
     # Phase 2: sig-cache probe, then one deduplicated device dispatch for
     # every remaining recorded check (sigcache.cpp:101-122 seam).
-    unique: Dict[Tuple, int] = {}
-    ordered: List[SigCheck] = []
-    for prep in preps:
-        for chk in prep.checks:
-            key = (chk.kind, chk.data)
-            if key not in unique:
-                unique[key] = len(ordered)
-                ordered.append(chk)
-    known: List[Optional[bool]] = [
-        True if sig_cache.contains_check(c.kind, c.data) else None for c in ordered
-    ]
-    to_run = [i for i, k in enumerate(known) if k is None]
-    if to_run:
-        run_res = verifier.verify_checks([ordered[i] for i in to_run])
-        for i, r in zip(to_run, run_res):
-            known[i] = bool(r)
-            if r:  # success-only insertion, like the reference
-                sig_cache.add_check(ordered[i].kind, ordered[i].data)
-    results = known
+    known: Dict[Tuple, bool] = {}
 
-    # Phase 3: accept optimistic verdicts; re-run exactly where any curve
-    # check came back False (its result feeds control flow). Successful
-    # inputs feed the script-execution cache for future batches.
-    out: List[BatchResult] = []
-    for idx, (item, prep) in enumerate(zip(items, preps)):
+    def resolve(checks: Sequence[SigCheck]) -> None:
+        """Fill `known` for every check: sig-cache probe, then ONE
+        deduplicated device dispatch; successes feed the cache."""
+        fresh: List[SigCheck] = []
+        for chk in checks:
+            key = (chk.kind, chk.data)
+            if key in known:
+                continue
+            if sig_cache.contains_check(chk.kind, chk.data):
+                known[key] = True
+            else:
+                known[key] = False  # placeholder until the dispatch lands
+                fresh.append(chk)
+        if fresh:
+            run_res = verifier.verify_checks(fresh)
+            for chk, r in zip(fresh, run_res):
+                known[(chk.kind, chk.data)] = bool(r)
+                if r:  # success-only insertion, like the reference
+                    sig_cache.add_check(chk.kind, chk.data)
+
+    resolve([chk for prep in preps for chk in prep.checks])
+
+    # Phase 3: accept verdicts whose guesses all held; where any guess
+    # failed, RE-interpret with the device results as an oracle —
+    # newly-discovered checks (e.g. the true CHECKMULTISIG sig/key
+    # pairing) go out as further batched dispatches until a fixpoint, so
+    # control-flow-dependent scripts resolve without host EC math. A
+    # round cap guards pathological scripts; the host checker is the
+    # exact fallback.
+    final: Dict[int, Tuple[bool, ScriptError]] = {}
+    pending: List[int] = []
+    for idx, prep in enumerate(preps):
         if prep.result is not None:
-            out.append(prep.result)
             continue
-        all_true = all(
-            results[unique[(chk.kind, chk.data)]] for chk in prep.checks
-        )
-        if all_true:
-            ok, err = prep.optimistic
+        if all(known[(c.kind, c.data)] for c in prep.checks):
+            final[idx] = prep.optimistic
         else:
-            checker = TransactionSignatureChecker(
-                prep.tx, item.input_index, prep.amount, prep.txdata
+            pending.append(idx)
+
+    max_rounds = 24  # > MAX_PUBKEYS_PER_MULTISIG cursor retries
+    for _round in range(max_rounds):
+        if not pending:
+            break
+        new_checks: List[SigCheck] = []
+        still: List[int] = []
+        for idx in pending:
+            item, prep = items[idx], preps[idx]
+            checker = DeferringSignatureChecker(
+                prep.tx, item.input_index, prep.amount, prep.txdata, known=known
             )
             ok, err = verify_script(
                 prep.tx.vin[item.input_index].script_sig,
@@ -277,6 +332,36 @@ def verify_batch(
                 item.flags,
                 checker,
             )
+            if checker.unknown == 0:
+                final[idx] = (ok, err)  # every oracle read was exact
+            else:
+                new_checks.extend(checker.recorded)
+                still.append(idx)
+        if not still:
+            pending = []
+            break
+        resolve(new_checks)
+        pending = still
+
+    for idx in pending:  # round cap hit: exact host fallback
+        item, prep = items[idx], preps[idx]
+        checker = TransactionSignatureChecker(
+            prep.tx, item.input_index, prep.amount, prep.txdata
+        )
+        final[idx] = verify_script(
+            prep.tx.vin[item.input_index].script_sig,
+            prep.script_pubkey,
+            prep.tx.vin[item.input_index].witness,
+            item.flags,
+            checker,
+        )
+
+    out: List[BatchResult] = []
+    for idx, (item, prep) in enumerate(zip(items, preps)):
+        if prep.result is not None:
+            out.append(prep.result)
+            continue
+        ok, err = final[idx]
         if ok:
             if spent_digests[idx] is not None:
                 script_cache.add_input(

@@ -129,17 +129,27 @@ def _inf_like(X):
     return ones, ones, zeros
 
 
-def jacobian_madd_complete(X1, Y1, Z1, x2, y2):
+def jacobian_madd_complete(X1, Y1, Z1, x2, y2, inf1=None):
     """Complete mixed addition (X1,Y1,Z1) + (x2,y2), (x2,y2) affine and
     never infinity. Branchless handling of every exceptional case; the
     generic path is madd-2007-bl (the math of `secp256k1_gej_add_ge_var`,
-    vectorized and de-branched)."""
+    vectorized and de-branched).
+
+    `inf1`: caller-known infinity status of the left operand — None
+    computes the Z1 ≡ 0 field test (legacy), False asserts the operand is
+    finite on every live lane, a mask uses it directly. Loop callers that
+    track infinity explicitly skip one of the three exact-zero chains.
+    """
     Z1Z1 = fe_sqr(Z1)
     U2 = fe_mul(x2, Z1Z1)
     S2 = fe_mul(y2, fe_mul(Z1, Z1Z1))
     H = fe_sub(U2, X1)
     Rsub = fe_sub(S2, Y1)
-    h_zero, r_zero, z1_zero = fe_is_zero_many((H, Rsub, Z1))
+    if inf1 is None:
+        h_zero, r_zero, z1_zero = fe_is_zero_many((H, Rsub, Z1))
+    else:
+        h_zero, r_zero = fe_is_zero_many((H, Rsub))
+        z1_zero = inf1
 
     HH = fe_sqr(H)
     I = fe_mul_small(HH, 4)
@@ -152,21 +162,27 @@ def jacobian_madd_complete(X1, Y1, Z1, x2, y2):
     out = (X3, Y3, Z3)
 
     dbl = jacobian_double(X1, Y1, Z1)
+    out = _select(h_zero & r_zero, dbl, out)
+    out = _select(h_zero & ~r_zero, _inf_like(X1), out)
+    if z1_zero is False:
+        # Known-finite left operand: result is infinite only via P+(-P).
+        return out + (h_zero & ~r_zero,)
     ones = jnp.broadcast_to(_col(_ONE, X1), X1.shape).astype(X1.dtype)
     lift = (jnp.broadcast_to(x2, X1.shape).astype(X1.dtype),
             jnp.broadcast_to(y2, X1.shape).astype(X1.dtype), ones)
-
-    out = _select(h_zero & r_zero, dbl, out)
-    out = _select(h_zero & ~r_zero, _inf_like(X1), out)
     out = _select(z1_zero, lift, out)
-    return out
+    if inf1 is None:
+        return out
+    # inf1 given: also report the result's infinity (affine op is finite).
+    return out + (~z1_zero & h_zero & ~r_zero,)
 
 
-def jacobian_add_complete(X1, Y1, Z1, X2, Y2, Z2, inf2):
+def jacobian_add_complete(X1, Y1, Z1, X2, Y2, Z2, inf2, inf1=None):
     """Complete Jacobian+Jacobian addition (add-2007-bl), branchless.
 
     `inf2` is the caller-known infinity mask for the second operand (table
-    entry 0), avoiding a field-level zero test on Z2."""
+    entry 0), avoiding a field-level zero test on Z2. `inf1` (optional)
+    does the same for the first operand — None computes the Z1 ≡ 0 test."""
     Z1Z1 = fe_sqr(Z1)
     Z2Z2 = fe_sqr(Z2)
     U1 = fe_mul(X1, Z2Z2)
@@ -175,7 +191,11 @@ def jacobian_add_complete(X1, Y1, Z1, X2, Y2, Z2, inf2):
     S2 = fe_mul(Y2, fe_mul(Z1, Z1Z1))
     H = fe_sub(U2, U1)
     Rsub = fe_sub(S2, S1)
-    h_zero, r_zero, z1_zero = fe_is_zero_many((H, Rsub, Z1))
+    if inf1 is None:
+        h_zero, r_zero, z1_zero = fe_is_zero_many((H, Rsub, Z1))
+    else:
+        h_zero, r_zero = fe_is_zero_many((H, Rsub))
+        z1_zero = inf1
 
     I = fe_sqr(fe_mul_small(H, 2))
     J = fe_mul(H, I)
@@ -193,7 +213,11 @@ def jacobian_add_complete(X1, Y1, Z1, X2, Y2, Z2, inf2):
     out = _select(h_zero & ~r_zero, _inf_like(X1), out)
     out = _select(z1_zero, (X2, Y2, Z2), out)
     out = _select(inf2, (X1, Y1, Z1), out)
-    return out
+    if inf1 is None:
+        return out
+    # Result infinity: both operands infinite, or finite cancellation.
+    out_inf = (z1_zero & inf2) | (~z1_zero & ~inf2 & h_zero & ~r_zero)
+    return out + (out_inf,)
 
 
 def scalar_bits(limbs):
@@ -246,7 +270,8 @@ def _fixed_base_mult(a_digits):
     gy_f = gy_t.astype(jnp.float32)
     k255 = jnp.arange(1, 256, dtype=jnp.int32)[:, None]  # (255, 1)
 
-    def body(i, RG):
+    def body(i, carry):
+        X, Y, Z, rg_inf = carry
         da = a_digits[i]  # (B,)
         oh = (da[None, :] == k255).astype(jnp.float32)  # (255, B)
         gxw = lax.dynamic_index_in_dim(gx_f, i, axis=0, keepdims=False)
@@ -260,12 +285,18 @@ def _fixed_base_mult(a_digits):
                        precision=lax.Precision.HIGHEST)
         selx = selx.astype(jnp.int32)  # (20, B), exact
         sely = sely.astype(jnp.int32)
-        RGa = jacobian_madd_complete(*RG, selx, sely)
-        return _select(da > 0, RGa, RG)
+        Xa, Ya, Za, inf_a = jacobian_madd_complete(
+            X, Y, Z, selx, sely, inf1=rg_inf
+        )
+        app = da > 0
+        out = _select(app, (Xa, Ya, Za), (X, Y, Z))
+        return out + (jnp.where(app, inf_a, rg_inf),)
 
     zeros = jnp.zeros_like(a_digits[0])
     inf = _inf_like(zeros[None].repeat(NLIMB, axis=0))
-    return lax.fori_loop(0, G_WINDOWS, body, inf)
+    all_inf = jnp.ones(a_digits.shape[1:], dtype=bool)
+    X, Y, Z, rg_inf = lax.fori_loop(0, G_WINDOWS, body, inf + (all_inf,))
+    return (X, Y, Z), rg_inf
 
 
 def _p_table(px, py):
@@ -275,7 +306,10 @@ def _p_table(px, py):
     inf = _inf_like(px)
 
     def step(carry, _):
-        nxt = jacobian_madd_complete(*carry, px, py)
+        # carry = k·P, k >= 1 — never infinity for on-curve P (order n
+        # >> 16), so the Z1 exact test is skipped (inf1=False).
+        *nxt, _cancel = jacobian_madd_complete(*carry, px, py, inf1=False)
+        nxt = tuple(nxt)
         return nxt, nxt
 
     _, tail = lax.scan(step, (px, py, ones), None, length=14)
@@ -316,9 +350,7 @@ def double_scalar_mult(a, b, px, py):
         return jacobian_add_complete(*R, selx, sely, selz, db == 0)
 
     R = lax.fori_loop(0, P_WINDOWS, body, _inf_like(px))
-    RG = _fixed_base_mult(digits_a)
-    # Join halves. RG is infinite iff a had no nonzero digit.
-    rg_inf = jnp.all(digits_a == 0, axis=0)
+    RG, rg_inf = _fixed_base_mult(digits_a)
     return jacobian_add_complete(*R, *RG, rg_inf)
 
 
@@ -362,9 +394,13 @@ def double_scalar_mult_glv(a, db1, db2, neg1, neg2, px, py):
     n1 = neg1[None]
     n2 = neg2[None]
 
-    def body(i, R):
+    def body(i, carry):
+        # R's infinity is tracked explicitly across the loop: the adds
+        # skip the Z1 ≡ 0 exact test and report the result's status.
+        X, Y, Z, r_inf = carry
+        R = (X, Y, Z)
         w = GLV_WINDOWS - 1 - i
-        R = jacobian_double(*R)
+        R = jacobian_double(*R)  # doublings preserve infinity
         R = jacobian_double(*R)
         R = jacobian_double(*R)
         R = jacobian_double(*R)
@@ -374,19 +410,24 @@ def double_scalar_mult_glv(a, db1, db2, neg1, neg2, px, py):
         sy = jnp.sum(TY * oh, axis=0)
         sz = jnp.sum(TZ * oh, axis=0)
         sy = jnp.where(n1, fe_sub(jnp.zeros_like(sy), sy), sy)
-        R = jacobian_add_complete(*R, sx, sy, sz, d1 == 0)
+        *R, r_inf = jacobian_add_complete(*R, sx, sy, sz, d1 == 0, inf1=r_inf)
         d2 = db2[w]
         oh = (d2[None] == k16).astype(jnp.int32)
         sx = fe_mul(jnp.sum(TX * oh, axis=0), beta)  # lambda*(x,y)=(bx,y)
         sy = jnp.sum(TY * oh, axis=0)
         sz = jnp.sum(TZ * oh, axis=0)
         sy = jnp.where(n2, fe_sub(jnp.zeros_like(sy), sy), sy)
-        return jacobian_add_complete(*R, sx, sy, sz, d2 == 0)
+        X, Y, Z, r_inf = jacobian_add_complete(*R, sx, sy, sz, d2 == 0, inf1=r_inf)
+        return X, Y, Z, r_inf
 
-    R = lax.fori_loop(0, GLV_WINDOWS, body, _inf_like(px))
-    RG = _fixed_base_mult(digits_a)
-    rg_inf = jnp.all(digits_a == 0, axis=0)
-    return jacobian_add_complete(*R, *RG, rg_inf)
+    all_inf = jnp.ones(px.shape[1:], dtype=bool)
+    R = lax.fori_loop(0, GLV_WINDOWS, body, _inf_like(px) + (all_inf,))
+    X, Y, Z, r_inf = R
+    RG, rg_inf = _fixed_base_mult(digits_a)
+    X, Y, Z, out_inf = jacobian_add_complete(
+        X, Y, Z, *RG, rg_inf, inf1=r_inf
+    )
+    return X, Y, Z, out_inf
 
 
 def double_scalar_mult_bits(a, b, px, py):
@@ -409,13 +450,15 @@ def double_scalar_mult_bits(a, b, px, py):
     return lax.fori_loop(0, 256, body, _inf_like(px))
 
 
-def jacobian_to_affine(X, Y, Z):
+def jacobian_to_affine(X, Y, Z, inf=None):
     """(X, Y, Z) -> (x, y, is_infinity) with x, y canonical in [0, p).
 
     (20, B) batches share one Montgomery-trick inversion across the batch
     (fe_batch_inv, ~4 muls/lane); other shapes fall back to per-lane
-    Fermat. Infinity lanes return x = y = 0."""
-    inf = fe_is_zero(Z)
+    Fermat. Infinity lanes return x = y = 0. `inf` (optional) is a
+    caller-tracked infinity mask, replacing the Z ≡ 0 exact test."""
+    if inf is None:
+        inf = fe_is_zero(Z)
     if Z.ndim == 2:
         zi = fe_batch_inv(Z, inf)
     else:

@@ -55,7 +55,7 @@ from .curve import (
     jacobian_double,
     jacobian_madd_complete,
 )
-from .curve import _BETA_LIMBS, _ONE, _digits128
+from .curve import _BETA_LIMBS, _GX_LIMBS, _GY_LIMBS, _ONE, _digits128
 from .limbs import (
     MASK,
     NLIMB,
@@ -85,7 +85,7 @@ _N_LIMBS = int_to_limbs(_N_INT)
 # Rows of the constant-table kernel input (pallas kernels cannot capture
 # array constants; see limbs.set_const_provider).
 _CONST_TABLE = np.stack(
-    [_SEVEN, _ONE, _SUB_BIAS, _P_LIMBS, _BETA_LIMBS]
+    [_SEVEN, _ONE, _SUB_BIAS, _P_LIMBS, _BETA_LIMBS, _GX_LIMBS, _GY_LIMBS]
 ).astype(np.int32)
 _CONST_ROWS = {
     _SEVEN.tobytes(): 0,
@@ -93,6 +93,8 @@ _CONST_ROWS = {
     np.asarray(_SUB_BIAS).tobytes(): 2,
     np.asarray(_P_LIMBS).tobytes(): 3,
     np.asarray(_BETA_LIMBS).tobytes(): 4,
+    np.asarray(_GX_LIMBS).tobytes(): 5,
+    np.asarray(_GY_LIMBS).tobytes(): 6,
 }
 
 # Square-and-multiply schedules (MSB-first, first bit consumed by init).
@@ -239,6 +241,13 @@ def _kernel_body(
     flip = odd != (want_odd == 1)
     py = jnp.where(flip[None], yneg, ycand)
     valid = valid & sq_ok
+    # Sanitize invalid (off-curve) lanes to the generator: keeps the
+    # explicitly-tracked infinity masks sound for every lane (see the
+    # XLA kernel's matching comment); verdicts stay masked by `valid`.
+    gxb = jnp.broadcast_to(_const_col(_GX_LIMBS, px), px.shape).astype(px.dtype)
+    gyb = jnp.broadcast_to(_const_col(_GY_LIMBS, px), px.shape).astype(px.dtype)
+    px = jnp.where(valid[None], px, gxb)
+    py = jnp.where(valid[None], py, gyb)
 
     # -- per-lane Jacobian table {0..15}·P into VMEM scratch ------------
     # (fori_loop + dynamic scratch store; Mosaic cannot lower a scan with
@@ -249,7 +258,9 @@ def _kernel_body(
     tx_ref[1], ty_ref[1], tz_ref[1] = px, py, ones
 
     def tstep(k, carry):
-        nxt = jacobian_madd_complete(*carry, px, py)
+        # carry = k·P, never infinity for on-curve P (inf1=False).
+        *nxt, _cancel = jacobian_madd_complete(*carry, px, py, inf1=False)
+        nxt = tuple(nxt)
         tx_ref[k], ty_ref[k], tz_ref[k] = nxt
         return nxt
 
@@ -266,9 +277,14 @@ def _kernel_body(
     n1 = neg1[None]
     n2 = neg2[None]
 
-    def wbody(i, R):
+    # Infinity masks ride the fori_loop carries as int32 0/1 — Mosaic
+    # cannot lower i1 vectors through loop boundaries.
+    def wbody(i, carry):
+        X, Y, Z, r_inf32 = carry
+        r_inf = r_inf32 == 1
+        R = (X, Y, Z)
         w = GLV_WINDOWS - 1 - i
-        R = jacobian_double(*R)
+        R = jacobian_double(*R)  # doublings preserve infinity
         R = jacobian_double(*R)
         R = jacobian_double(*R)
         R = jacobian_double(*R)
@@ -278,22 +294,34 @@ def _kernel_body(
         sely = jnp.sum(TY * oh, axis=0)
         selz = jnp.sum(TZ * oh, axis=0)
         sely = jnp.where(n1, fe_sub(jnp.zeros_like(sely), sely), sely)
-        R = jacobian_add_complete(*R, selx, sely, selz, d1 == 0)
+        *R, r_inf = jacobian_add_complete(
+            *R, selx, sely, selz, d1 == 0, inf1=r_inf
+        )
         d2 = db2_ref[w]
         oh = (d2[None, None, :] == k16).astype(jnp.int32)
         selx = fe_mul(jnp.sum(TX * oh, axis=0), beta)
         sely = jnp.sum(TY * oh, axis=0)
         selz = jnp.sum(TZ * oh, axis=0)
         sely = jnp.where(n2, fe_sub(jnp.zeros_like(sely), sely), sely)
-        return jacobian_add_complete(*R, selx, sely, selz, d2 == 0)
+        X, Y, Z, r_inf = jacobian_add_complete(
+            *R, selx, sely, selz, d2 == 0, inf1=r_inf
+        )
+        return X, Y, Z, r_inf.astype(jnp.int32)
 
-    R = lax.fori_loop(0, GLV_WINDOWS, wbody, _inf_like(px))
+    all_inf = jnp.ones(px.shape[1:], dtype=jnp.int32)
+    X, Y, Z, r_inf32 = lax.fori_loop(
+        0, GLV_WINDOWS, wbody, _inf_like(px) + (all_inf,)
+    )
+    r_inf = r_inf32 == 1
+    R = (X, Y, Z)
 
     # -- a·G: 32 windows, MXU one-hot row select against the VMEM table -
     # Table row j holds (j+1)·256^w·G: the one-hot compares against 1..255.
     k255 = jax.lax.broadcasted_iota(jnp.int32, (255, 1), 0) + 1
 
-    def gbody(i, RG):
+    def gbody(i, carry):
+        Xg, Yg, Zg, rg_inf32 = carry
+        rg_inf = rg_inf32 == 1
         da = da_ref[i]  # ref-indexed dynamic VMEM load, (tile,)
         oh = (da[None, :] == k255).astype(jnp.float32)  # (255, T)
         gxw = gx_ref[i]  # (255, 20) f32
@@ -308,15 +336,22 @@ def _kernel_body(
             preferred_element_type=jnp.float32,
             precision=lax.Precision.HIGHEST,
         ).astype(jnp.int32)
-        RGa = jacobian_madd_complete(*RG, selx, sely)
-        return _select(da > 0, RGa, RG)
+        Xa, Ya, Za, inf_a = jacobian_madd_complete(
+            Xg, Yg, Zg, selx, sely, inf1=rg_inf
+        )
+        app = da > 0
+        out = _select(app, (Xa, Ya, Za), (Xg, Yg, Zg))
+        # int32 branch values: Mosaic cannot lower selects over i1 vectors.
+        return out + (jnp.where(app, inf_a.astype(jnp.int32), rg_inf32),)
 
-    RG = lax.fori_loop(0, G_WINDOWS, gbody, _inf_like(px))
-    rg_inf = jnp.all(da_ref[:] == 0, axis=0)
-    X, Y, Z = jacobian_add_complete(*R, *RG, rg_inf)
+    Xg, Yg, Zg, rg_inf32 = lax.fori_loop(
+        0, G_WINDOWS, gbody, _inf_like(px) + (all_inf,)
+    )
+    X, Y, Z, inf_mask = jacobian_add_complete(
+        *R, Xg, Yg, Zg, rg_inf32 == 1, inf1=r_inf
+    )
 
     # -- affine + accept -------------------------------------------------
-    inf_mask = fe_is_zero(Z)
     zi = _tile_batch_inv(Z, inf_mask, ones, inv_bits_ref)
     zi2 = fe_sqr(zi)
     x = fe_canon(fe_mul(X, zi2))

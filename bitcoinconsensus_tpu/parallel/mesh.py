@@ -47,8 +47,9 @@ def make_mesh(n_devices: Optional[int] = None, axis: str = "batch") -> Mesh:
 def make_sharded_step(mesh: Mesh):
     """The full multichip verify step, jitted over `mesh`.
 
-    Returns ``step(a, b, px, py, want_odd, t1, t2, parity, valid, live)
-    -> (per_lane, all_ok)`` where inputs are batch-sharded, `per_lane`
+    Returns ``step(fields, want_odd, parity_req, has_t2, neg1, neg2,
+    valid, live) -> (per_lane, all_ok)`` where inputs are batch-sharded,
+    `per_lane`
     comes back batch-sharded, and `all_ok` is a replicated scalar produced
     by a psum AND-reduction inside shard_map (the cross-chip collective —
     the `CCheckQueueControl::Wait` analogue, checkqueue.h:139-142).

@@ -214,3 +214,40 @@ def test_taproot_single_api_roundtrip():
     with pytest.raises(ConsensusError) as ei:
         api.verify_with_spent_outputs(txb, 0, [(amt, spk)])
     assert ei.value.script_error == ScriptError.SCHNORR_SIG
+
+
+def test_multisig_subset_resolves_on_device(monkeypatch):
+    """A 2-of-3 whose sigs belong to the LOWER keys: the optimistic
+    CHECKMULTISIG cursor guesses the wrong pairing, and the corrected
+    control flow must converge via oracle rounds of batched device
+    dispatches — never host EC math (the 14ms/input trap this guards)."""
+    from bitcoinconsensus_tpu.core import interpreter as I
+    from bitcoinconsensus_tpu.models.sigcache import (
+        ScriptExecutionCache,
+        SigCache,
+    )
+    from bitcoinconsensus_tpu.utils.blockgen import build_spend_tx, make_funded_view
+
+    _, funded = make_funded_view(3, kinds=("p2wsh_multisig",), seed="msdev")
+    items = []
+    for f in funded:
+        tx = build_spend_tx([f])
+        items.append(
+            BatchItem(
+                tx.serialize(),
+                0,
+                VERIFY_ALL_LIBCONSENSUS,
+                spent_output_script=f.wallet.spk,
+                amount=f.amount,
+            )
+        )
+
+    def boom(*a, **k):  # the host-crypto fallback must stay cold
+        raise AssertionError("host EC verify reached on the device path")
+
+    monkeypatch.setattr(I.TransactionSignatureChecker, "verify_ecdsa", boom)
+    monkeypatch.setattr(I.TransactionSignatureChecker, "verify_schnorr", boom)
+    res = verify_batch(
+        items, sig_cache=SigCache(), script_cache=ScriptExecutionCache()
+    )
+    assert all(r.ok for r in res)
