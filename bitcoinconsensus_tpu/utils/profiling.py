@@ -24,7 +24,7 @@ import time
 from contextlib import contextmanager
 from typing import Dict
 
-__all__ = ["Phases"]
+__all__ = ["Phases", "xla_trace"]
 
 
 class Phases:
@@ -58,3 +58,16 @@ class Phases:
 
     def total(self) -> float:
         return sum(self._secs.values())
+
+
+@contextmanager
+def xla_trace(log_dir: str = "/tmp/bitcoinconsensus_tpu_trace"):
+    """XLA/TPU profiler hook: wraps a region in `jax.profiler.trace` so
+    device-side timing (kernel occupancy, transfers) lands in a
+    TensorBoard-readable trace under `log_dir`. Complements the host-side
+    `Phases` attribution; used by `scripts/profile_verify.py --xla-trace`."""
+    import jax
+
+    with jax.profiler.trace(log_dir):
+        yield
+    print(f"xla trace written to {log_dir}")

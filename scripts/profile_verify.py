@@ -12,7 +12,7 @@ import time
 
 sys.path.insert(0, __file__.rsplit("/", 2)[0])
 
-BATCH = int(sys.argv[1]) if len(sys.argv) > 1 else 8192
+BATCH = int(sys.argv[1]) if len(sys.argv) > 1 and sys.argv[1].isdigit() else 8192
 
 
 def main():
@@ -37,6 +37,12 @@ def main():
     res = verifier.verify_checks(checks)  # compile + warmup
     print(f"warmup (incl. compile): {time.time()-t0:.1f}s", file=sys.stderr)
     assert res.all()
+
+    if "--xla-trace" in sys.argv:
+        from bitcoinconsensus_tpu.utils.profiling import xla_trace
+
+        with xla_trace():
+            verifier.verify_checks(checks)
 
     best = None
     for _ in range(3):
