@@ -56,8 +56,10 @@ def main() -> None:
     assert res.all(), "bench signatures must verify"
     print(f"warmup (incl. compile): {warm:.1f}s", file=sys.stderr)
 
+    # Best-of-5: the device link's latency is bursty; a single bad window
+    # must not define the recorded number.
     best = float("inf")
-    for _ in range(3):
+    for _ in range(5):
         t0 = time.time()
         res = verifier.verify_checks(checks)
         dt = time.time() - t0
