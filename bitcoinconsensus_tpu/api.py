@@ -86,12 +86,48 @@ def _verify_input(
     spent_outputs: Optional[Sequence[TxOut]] = None,
 ) -> None:
     """Shared body of the verify entry points; mirrors
-    bitcoinconsensus.cpp:79-101 verify_script check order."""
+    bitcoinconsensus.cpp:79-101 verify_script check order. Runs on the
+    native host core (native/eval.hpp) when available — same transport
+    checks, same ScriptErrors (tests/test_native_interp.py) — with the
+    Python engine as spec and fallback."""
     if flags & ~allowed_flags:
         raise ConsensusError(Error.ERR_INVALID_FLAGS)
+
+    from . import native_bridge
+
+    if native_bridge.available():
+        try:
+            ntx = native_bridge.NativeTx(spending_transaction)
+        except ValueError:
+            raise ConsensusError(Error.ERR_TX_DESERIALIZE) from None
+        # nIn is unsigned in the reference ABI: negative indices are
+        # out-of-range, never Python-style wraparound.
+        if input_index < 0 or input_index >= ntx.n_inputs:
+            raise ConsensusError(Error.ERR_TX_INDEX)
+        if ntx.ser_size != len(spending_transaction):
+            raise ConsensusError(Error.ERR_TX_SIZE_MISMATCH)
+        if spent_outputs is not None:
+            if len(spent_outputs) != ntx.n_inputs:
+                raise ConsensusError(Error.ERR_TX_INDEX)
+            ntx.set_spent_outputs(
+                [(o.value, o.script_pubkey) for o in spent_outputs]
+            )
+        else:
+            if flags & VERIFY_TAPROOT:
+                raise ConsensusError(Error.ERR_AMOUNT_REQUIRED)
+            ntx.precompute()
+        sess = native_bridge.NativeSession()
+        ok, err_code, _ = sess.verify_input(
+            ntx, input_index, amount, spent_output_script, flags,
+            mode=native_bridge.NativeSession.MODE_EXACT,
+        )
+        if not ok:
+            raise ConsensusError(Error.ERR_SCRIPT, ScriptError(err_code))
+        return
+
     try:
         tx = Tx.deserialize(spending_transaction)
-        if input_index >= len(tx.vin):
+        if input_index < 0 or input_index >= len(tx.vin):
             raise ConsensusError(Error.ERR_TX_INDEX)
         if len(tx.serialize()) != len(spending_transaction):
             raise ConsensusError(Error.ERR_TX_SIZE_MISMATCH)
@@ -168,7 +204,7 @@ def verify_with_spent_outputs(
     verify_script_with_spent_outputs ABI adopted).
     """
     outs = [TxOut(amt, spk) for amt, spk in spent_outputs]
-    if input_index >= len(outs):
+    if input_index < 0 or input_index >= len(outs):
         raise ConsensusError(Error.ERR_TX_INDEX)
     _verify_input(
         outs[input_index].script_pubkey,

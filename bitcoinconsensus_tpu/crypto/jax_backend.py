@@ -338,6 +338,12 @@ class TpuSecpVerifier:
                 self._use_pallas = jax.default_backend() == "tpu"
             except Exception:  # pragma: no cover
                 self._use_pallas = False
+        # Native host core (SURVEY §7): lane prep + packing in one C call,
+        # ~10x the Python packers. Bit-identical output (tests/test_native.py);
+        # the Python path stays as spec and fallback.
+        from .. import native_bridge
+
+        self._native = native_bridge if native_bridge.available() else None
         self.phases = Phases()  # host_prep / pack / dispatch / sync
 
     def _pad(self, n: int) -> int:
@@ -377,12 +383,20 @@ class TpuSecpVerifier:
         pending = []  # (device_result, start, count)
         for start in range(0, len(checks), self._chunk):
             sub_checks = checks[start : start + self._chunk]
-            with self.phases("host_prep"):
-                sub = self._prep_lanes(sub_checks)
-            with self.phases("pack"):
-                args = self._pack_lanes(sub)
+            if self._native is not None:
+                with self.phases("host_prep"):
+                    args = self._native.prep_pack(
+                        sub_checks, self._pad(len(sub_checks))
+                    )
+            else:
+                with self.phases("host_prep"):
+                    sub = self._prep_lanes(sub_checks)
+                with self.phases("pack"):
+                    args = self._pack_lanes(sub)
             with self.phases("dispatch"):
-                pending.append((self._run_kernel(args, len(sub)), start, len(sub)))
+                pending.append(
+                    (self._run_kernel(args, len(sub_checks)), start, len(sub_checks))
+                )
         out = np.zeros(len(checks), dtype=bool)
         with self.phases("sync"):
             for res, start, count in pending:

@@ -45,6 +45,7 @@ from ..core.serialize import SerializationError
 from ..core.sighash import PrecomputedTxData
 from ..core.tx import Tx, TxOut
 from ..crypto.jax_backend import SigCheck, TpuSecpVerifier, default_verifier
+from .. import native_bridge
 from .sigcache import (
     ScriptExecutionCache,
     SigCache,
@@ -130,6 +131,7 @@ class _Prepared:
     amount: int = 0
     optimistic: Optional[Tuple[bool, ScriptError]] = None
     checks: List[SigCheck] = field(default_factory=list)
+    ntx: Optional[object] = None  # native_bridge.NativeTx when native is on
 
 
 def _spent_memo_entry(item: BatchItem, spent_memo: Dict[int, Tuple]):
@@ -148,9 +150,10 @@ def _spent_memo_entry(item: BatchItem, spent_memo: Dict[int, Tuple]):
 
 def _prepare(
     item: BatchItem,
-    tx_cache: Dict[bytes, Tx],
+    tx_cache: Dict[bytes, Tuple[Tx, bool]],
     txdata_cache: Dict[Tuple, PrecomputedTxData],
     spent_memo: Dict[int, Tuple],
+    ntx_cache: Optional[Dict] = None,
 ) -> _Prepared:
     """Transport-level validation; mirrors bitcoinconsensus.cpp:79-101 check
     order (flags -> deserialize -> index -> size). PrecomputedTxData is
@@ -163,15 +166,23 @@ def _prepare(
         prep.result = BatchResult(False, Error.ERR_INVALID_FLAGS)
         return prep
     try:
-        tx = tx_cache.get(item.spending_tx)
-        if tx is None:
+        cached = tx_cache.get(item.spending_tx)
+        if cached is None:
             tx = Tx.deserialize(item.spending_tx)
-            if len(tx.serialize()) != len(item.spending_tx):
-                prep.result = BatchResult(False, Error.ERR_TX_SIZE_MISMATCH)
-                return prep
-            tx_cache[item.spending_tx] = tx
-        if item.input_index >= len(tx.vin):
+            size_ok = len(tx.serialize()) == len(item.spending_tx)
+            tx_cache[item.spending_tx] = (tx, size_ok)
+        else:
+            tx, size_ok = cached
+        # Index before size, matching api._verify_input and the reference
+        # (bitcoinconsensus.cpp:89-92): a tx with both trailing bytes AND an
+        # out-of-range index must report ERR_TX_INDEX from every entry point.
+        # nIn is unsigned in the reference ABI: negative is out-of-range,
+        # never Python wraparound.
+        if item.input_index < 0 or item.input_index >= len(tx.vin):
             prep.result = BatchResult(False, Error.ERR_TX_INDEX)
+            return prep
+        if not size_ok:
+            prep.result = BatchResult(False, Error.ERR_TX_SIZE_MISMATCH)
             return prep
     except SerializationError:
         prep.result = BatchResult(False, Error.ERR_TX_DESERIALIZE)
@@ -203,6 +214,21 @@ def _prepare(
         prep.script_pubkey = item.spent_output_script or b""
         prep.amount = item.amount
     prep.tx = tx
+    if ntx_cache is not None:
+        # Native tx handle, one per (tx, prevouts-digest) like txdata; the
+        # C++ side holds the parse + precomputed hash aggregates.
+        ntx = ntx_cache.get(tkey)
+        if ntx is None:
+            try:
+                ntx = native_bridge.NativeTx(item.spending_tx)
+                if item.spent_outputs is not None:
+                    ntx.set_spent_outputs(list(item.spent_outputs))
+                else:
+                    ntx.precompute()
+            except ValueError:  # pragma: no cover - python parse succeeded
+                ntx = None
+            ntx_cache[tkey] = ntx
+        prep.ntx = ntx
     return prep
 
 
@@ -228,11 +254,15 @@ def verify_batch(
     if script_cache is None:
         script_cache = default_script_cache()
 
-    tx_cache: Dict[bytes, Tx] = {}
+    use_native = native_bridge.available()
+    nsess = native_bridge.NativeSession() if use_native else None
+    tx_cache: Dict[bytes, Tuple[Tx, bool]] = {}
     txdata_cache: Dict[Tuple, PrecomputedTxData] = {}
     spent_memo: Dict[int, Tuple] = {}
+    ntx_cache: Optional[Dict] = {} if use_native else None
     preps = [
-        _prepare(item, tx_cache, txdata_cache, spent_memo) for item in items
+        _prepare(item, tx_cache, txdata_cache, spent_memo, ntx_cache)
+        for item in items
     ]
 
     # Script-execution cache probe: a hit certifies this exact
@@ -254,12 +284,19 @@ def verify_batch(
         ):
             prep.result = BatchResult.success()
 
-    # Phase 1: optimistic interpretation, recording curve checks.
-    for item, prep in zip(items, preps):
-        if prep.result is not None:
-            continue
+    # Phase 1: optimistic interpretation, recording curve checks. The
+    # native engine (native/eval.hpp, deferring mode) runs the same
+    # protocol at C++ speed; the Python engine is the fallback and spec.
+    def interpret_deferring(item, prep) -> Tuple[bool, ScriptError, int, List[SigCheck]]:
+        if prep.ntx is not None:
+            ok, err_code, unk = nsess.verify_input(
+                prep.ntx, item.input_index, prep.amount, prep.script_pubkey,
+                item.flags, mode=native_bridge.NativeSession.MODE_DEFER,
+            )
+            checks = [SigCheck(k, d) for k, d in nsess.take_records()]
+            return ok, ScriptError(err_code), unk, checks
         checker = DeferringSignatureChecker(
-            prep.tx, item.input_index, prep.amount, prep.txdata
+            prep.tx, item.input_index, prep.amount, prep.txdata, known=known
         )
         ok, err = verify_script(
             prep.tx.vin[item.input_index].script_sig,
@@ -268,12 +305,28 @@ def verify_batch(
             item.flags,
             checker,
         )
+        return ok, err, checker.unknown, checker.recorded
+
+    known: Dict[Tuple, bool] = {}
+    for item, prep in zip(items, preps):
+        if prep.result is not None:
+            continue
+        ok, err, _unk, checks = interpret_deferring(item, prep)
         prep.optimistic = (ok, err)
-        prep.checks = checker.recorded
+        prep.checks = checks
 
     # Phase 2: sig-cache probe, then one deduplicated device dispatch for
-    # every remaining recorded check (sigcache.cpp:101-122 seam).
-    known: Dict[Tuple, bool] = {}
+    # every remaining recorded check (sigcache.cpp:101-122 seam). Results
+    # are published into the native oracle session as they land.
+    pushed: set = set()
+
+    def publish_known() -> None:
+        if nsess is None:
+            return
+        for key, val in known.items():
+            if key not in pushed:
+                nsess.add_known(key[0], key[1], val)
+                pushed.add(key)
 
     def resolve(checks: Sequence[SigCheck]) -> None:
         """Fill `known` for every check: sig-cache probe, then ONE
@@ -294,6 +347,7 @@ def verify_batch(
                 known[(chk.kind, chk.data)] = bool(r)
                 if r:  # success-only insertion, like the reference
                     sig_cache.add_check(chk.kind, chk.data)
+        publish_known()
 
     resolve([chk for prep in preps for chk in prep.checks])
 
@@ -322,20 +376,11 @@ def verify_batch(
         still: List[int] = []
         for idx in pending:
             item, prep = items[idx], preps[idx]
-            checker = DeferringSignatureChecker(
-                prep.tx, item.input_index, prep.amount, prep.txdata, known=known
-            )
-            ok, err = verify_script(
-                prep.tx.vin[item.input_index].script_sig,
-                prep.script_pubkey,
-                prep.tx.vin[item.input_index].witness,
-                item.flags,
-                checker,
-            )
-            if checker.unknown == 0:
+            ok, err, unknown, recorded = interpret_deferring(item, prep)
+            if unknown == 0:
                 final[idx] = (ok, err)  # every oracle read was exact
             else:
-                new_checks.extend(checker.recorded)
+                new_checks.extend(recorded)
                 still.append(idx)
         if not still:
             pending = []
@@ -345,6 +390,13 @@ def verify_batch(
 
     for idx in pending:  # round cap hit: exact host fallback
         item, prep = items[idx], preps[idx]
+        if prep.ntx is not None:
+            ok, err_code, _ = nsess.verify_input(
+                prep.ntx, item.input_index, prep.amount, prep.script_pubkey,
+                item.flags, mode=native_bridge.NativeSession.MODE_EXACT,
+            )
+            final[idx] = (ok, ScriptError(err_code))
+            continue
         checker = TransactionSignatureChecker(
             prep.tx, item.input_index, prep.amount, prep.txdata
         )

@@ -101,10 +101,13 @@ class SigCache(_SaltedLRU):
 
     @staticmethod
     def _parts(kind: str, data: Tuple) -> Tuple[bytes, ...]:
+        # Ints serialize at 8 bytes signed so a future check kind carrying
+        # e.g. a satoshi amount can never overflow the key builder (the
+        # length-prefixed digest keeps 4- and 8-byte encodings distinct).
         parts = [kind.encode()]
         for d in data:
             parts.append(
-                d if isinstance(d, bytes) else int(d).to_bytes(4, "little", signed=True)
+                d if isinstance(d, bytes) else int(d).to_bytes(8, "little", signed=True)
             )
         return tuple(parts)
 

@@ -224,6 +224,16 @@ def connect_block(
     fees = 0
     sigop_cost = 0
 
+    # BIP30 guard (validation.cpp ConnectBlock's HaveCoin scan, run against
+    # the start-of-block view before any spends): a tx whose outputs would
+    # overwrite a still-unspent coin is rejected instead of silently
+    # destroying it. In-block txid duplicates can't arise (identical txid
+    # implies an identical tx, caught by the CVE-2012-2459 merkle check).
+    for tx in block.vtx:
+        for n in range(len(tx.vout)):
+            if coins.get(OutPoint(tx.txid, n)) is not None:
+                return ConnectResult(False, "bad-txns-BIP30")
+
     for tx in block.vtx:
         if tx.is_coinbase():
             per_tx_spent_outputs.append([])

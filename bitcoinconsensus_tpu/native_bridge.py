@@ -1,0 +1,400 @@
+"""ctypes bridge to the native host core (`native/libnat.so`).
+
+SURVEY §7 prescribes a native host consensus core around the TPU crypto
+backend; this module is its loader + typed surface. The library is built
+on demand from the checked-in C++ sources (single `g++ -shared` call, a
+few seconds, cached by mtime) so the repo never carries a binary.
+
+Native components surfaced here:
+- `prep_lanes`: batched verify-lane preparation (structural pubkey parse,
+  lax-DER, high-S normalize, batched s^-1 mod n, BIP340 challenge hash,
+  GLV split, byte packing) — the TpuSecpVerifier host_prep/pack phases in
+  one C call.
+- `verify_ecdsa` / `verify_schnorr` / `tweak_add_check`: host-exact
+  scalar verifies (fast fallback path; the pure-Python
+  `crypto/secp_host.py` stays the executable spec they are tested
+  against).
+- `sha256` / `sha256d` / `tagged_hash` utilities.
+
+Set BITCOINCONSENSUS_TPU_NATIVE=0 to disable (pure-Python paths remain
+fully functional and consensus-exact).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+__all__ = ["available", "lib", "prep_pack", "NativeSecp"]
+
+_NATIVE_DIR = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "native"
+)
+_SO_PATH = os.path.join(_NATIVE_DIR, "libnat.so")
+_SOURCES = ("nat.cpp", "secp.hpp", "sha256.hpp", "hash_extra.hpp", "interp.hpp", "eval.hpp")
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_tried = False
+
+
+def _build() -> bool:
+    srcs = [os.path.join(_NATIVE_DIR, s) for s in _SOURCES]
+    if not all(os.path.exists(s) for s in srcs):
+        return False
+    if os.path.exists(_SO_PATH) and all(
+        os.path.getmtime(_SO_PATH) >= os.path.getmtime(s) for s in srcs
+    ):
+        return True
+    try:
+        subprocess.run(
+            [
+                os.environ.get("CXX", "g++"),
+                "-O2",
+                "-std=c++17",
+                "-fPIC",
+                "-shared",
+                os.path.join(_NATIVE_DIR, "nat.cpp"),
+                "-o",
+                _SO_PATH,
+            ],
+            check=True,
+            capture_output=True,
+            timeout=300,
+        )
+        return True
+    except Exception:
+        return False
+
+
+def lib() -> Optional[ctypes.CDLL]:
+    """The loaded library, or None (unbuildable / disabled)."""
+    global _lib, _tried
+    if os.environ.get("BITCOINCONSENSUS_TPU_NATIVE", "") in ("0", "off"):
+        return None
+    with _lock:
+        if _tried:
+            return _lib
+        _tried = True
+        if not _build():
+            return None
+        try:
+            L = ctypes.CDLL(_SO_PATH)
+        except OSError:
+            return None
+        u8p = ctypes.POINTER(ctypes.c_uint8)
+        i32p = ctypes.POINTER(ctypes.c_int32)
+        i64p = ctypes.POINTER(ctypes.c_int64)
+        L.nat_version.restype = ctypes.c_int
+        L.nat_prep_lanes.argtypes = [
+            u8p, i64p, i32p, ctypes.c_int32,
+            u8p, i32p, i32p, i32p, i32p, i32p, i32p,
+        ]
+        L.nat_prep_lanes.restype = None
+        L.nat_verify_ecdsa.argtypes = [u8p, ctypes.c_int64, u8p, ctypes.c_int64, u8p]
+        L.nat_verify_ecdsa.restype = ctypes.c_int
+        L.nat_verify_schnorr.argtypes = [u8p, u8p, u8p]
+        L.nat_verify_schnorr.restype = ctypes.c_int
+        L.nat_tweak_add_check.argtypes = [u8p, ctypes.c_int32, u8p, u8p]
+        L.nat_tweak_add_check.restype = ctypes.c_int
+        L.nat_sha256.argtypes = [u8p, ctypes.c_int64, u8p]
+        L.nat_sha256d.argtypes = [u8p, ctypes.c_int64, u8p]
+        L.nat_tagged_hash.argtypes = [u8p, ctypes.c_int64, u8p, ctypes.c_int64, u8p]
+        # interpreter surface
+        vp = ctypes.c_void_p
+        L.nat_session_new.restype = vp
+        L.nat_session_free.argtypes = [vp]
+        L.nat_session_add_known.argtypes = [
+            vp, ctypes.c_int32, ctypes.c_int32,
+            u8p, ctypes.c_int64, u8p, ctypes.c_int64, u8p, ctypes.c_int64,
+            ctypes.c_int32,
+        ]
+        L.nat_session_records_count.argtypes = [vp]
+        L.nat_session_records_count.restype = ctypes.c_int32
+        L.nat_session_records_meta.argtypes = [vp, i32p, i32p, i64p]
+        L.nat_session_records_data.argtypes = [vp, u8p]
+        L.nat_session_records_bytes.argtypes = [vp]
+        L.nat_session_records_bytes.restype = ctypes.c_int64
+        L.nat_tx_parse.argtypes = [u8p, ctypes.c_int64]
+        L.nat_tx_parse.restype = vp
+        L.nat_tx_free.argtypes = [vp]
+        L.nat_tx_ser_size.argtypes = [vp]
+        L.nat_tx_ser_size.restype = ctypes.c_int64
+        L.nat_tx_n_inputs.argtypes = [vp]
+        L.nat_tx_n_inputs.restype = ctypes.c_int32
+        L.nat_tx_set_spent_outputs.argtypes = [vp, i64p, u8p, i64p, ctypes.c_int32]
+        L.nat_tx_precompute.argtypes = [vp]
+        L.nat_verify_input.argtypes = [
+            vp, vp, ctypes.c_int32, ctypes.c_int64, u8p, ctypes.c_int64,
+            ctypes.c_int32, ctypes.c_int32, i32p, i32p,
+        ]
+        L.nat_verify_input.restype = ctypes.c_int32
+        _lib = L
+        return _lib
+
+
+def available() -> bool:
+    return lib() is not None
+
+
+def _u8p(arr: np.ndarray):
+    return arr.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8))
+
+
+def _i32p(arr: np.ndarray):
+    return arr.ctypes.data_as(ctypes.POINTER(ctypes.c_int32))
+
+
+_KIND_CODE = {"ecdsa": 0, "schnorr": 1, "tweak": 2}
+
+
+def prep_pack(checks: Sequence, size: int):
+    """Native _prep_lanes + _pack_lanes: returns the 7-tuple of padded
+    arrays TpuSecpVerifier feeds the kernel, bit-identical to the Python
+    packers (asserted by tests/test_native.py).
+
+    `checks` are SigCheck-shaped (kind, data); `size >= len(checks)` is
+    the padded batch size.
+    """
+    L = lib()
+    assert L is not None
+    n = len(checks)
+    assert size >= n
+    parts: List[bytes] = []
+    offs = np.empty(3 * n + 1, dtype=np.int64)
+    kinds = np.empty(n, dtype=np.int32)
+    pos = 0
+    for i, chk in enumerate(checks):
+        d = chk.data
+        if chk.kind == "tweak":
+            # (tweaked32, parity, internal32, tweak32) ->
+            # internal | tweak | tweaked, parity in the kind code
+            p0, p1, p2 = d[2], d[3], d[0]
+            kinds[i] = 2 | ((d[1] & 1) << 8)
+        else:
+            p0, p1, p2 = d[0], d[1], d[2]
+            kinds[i] = _KIND_CODE[chk.kind]
+        offs[3 * i] = pos
+        offs[3 * i + 1] = pos + len(p0)
+        offs[3 * i + 2] = pos + len(p0) + len(p1)
+        pos += len(p0) + len(p1) + len(p2)
+        parts.append(p0)
+        parts.append(p1)
+        parts.append(p2)
+    offs[3 * n] = pos
+    blob = np.frombuffer(b"".join(parts), dtype=np.uint8) if pos else np.zeros(
+        1, dtype=np.uint8
+    )
+
+    fields = np.zeros((size, 4, 32), dtype=np.uint8)
+    want_odd = np.zeros(size, dtype=np.int32)
+    parity = np.full(size, -1, dtype=np.int32)
+    has_t2 = np.zeros(size, dtype=np.int32)
+    neg1 = np.zeros(size, dtype=np.int32)
+    neg2 = np.zeros(size, dtype=np.int32)
+    valid_i = np.zeros(size, dtype=np.int32)
+    if n:
+        L.nat_prep_lanes(
+            _u8p(blob),
+            offs.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            _i32p(kinds),
+            n,
+            _u8p(fields),
+            _i32p(want_odd),
+            _i32p(parity),
+            _i32p(has_t2),
+            _i32p(neg1),
+            _i32p(neg2),
+            _i32p(valid_i),
+        )
+    return fields, want_odd, parity, has_t2, neg1, neg2, valid_i != 0
+
+
+_KIND_NAME = {0: "ecdsa", 1: "schnorr", 2: "tweak"}
+
+
+class NativeTx:
+    """Parsed-transaction handle (native/interp.hpp NTx). Holds the wire
+    parse and the tx-wide precomputed hash aggregates on the C++ side."""
+
+    __slots__ = ("_ptr", "n_inputs", "ser_size")
+
+    def __init__(self, raw: bytes):
+        L = lib()
+        assert L is not None
+        arr = np.frombuffer(raw, dtype=np.uint8) if raw else np.zeros(1, np.uint8)
+        ptr = L.nat_tx_parse(_u8p(arr), len(raw))
+        if not ptr:
+            raise ValueError("tx deserialize failed")
+        self._ptr = ptr
+        self.n_inputs = int(L.nat_tx_n_inputs(ptr))
+        self.ser_size = int(L.nat_tx_ser_size(ptr))
+
+    def __del__(self):
+        L = lib()
+        if L is not None and getattr(self, "_ptr", None):
+            L.nat_tx_free(self._ptr)
+            self._ptr = None
+
+    def set_spent_outputs(self, spent: Sequence[Tuple[int, bytes]]) -> None:
+        L = lib()
+        amounts = np.asarray([a for a, _ in spent], dtype=np.int64)
+        offs = np.zeros(len(spent) + 1, dtype=np.int64)
+        for i, (_, spk) in enumerate(spent):
+            offs[i + 1] = offs[i] + len(spk)
+        blob_b = b"".join(spk for _, spk in spent)
+        blob = np.frombuffer(blob_b, dtype=np.uint8) if blob_b else np.zeros(
+            1, np.uint8
+        )
+        L.nat_tx_set_spent_outputs(
+            self._ptr,
+            amounts.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            _u8p(blob),
+            offs.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            len(spent),
+        )
+
+    def precompute(self) -> None:
+        lib().nat_tx_precompute(self._ptr)
+
+
+class NativeSession:
+    """Deferral session (oracle map + per-call check records)."""
+
+    __slots__ = ("_ptr",)
+
+    MODE_DEFER = 0
+    MODE_EXACT = 1
+
+    def __init__(self):
+        L = lib()
+        assert L is not None
+        self._ptr = L.nat_session_new()
+
+    def __del__(self):
+        L = lib()
+        if L is not None and getattr(self, "_ptr", None):
+            L.nat_session_free(self._ptr)
+            self._ptr = None
+
+    def add_known(self, kind: str, data: Tuple, result: bool) -> None:
+        """Publish one resolved check into the native oracle; key layout
+        matches models/batch.py's `known` dict keys."""
+        L = lib()
+        if kind == "tweak":
+            p0, parity, p1, p2 = data[0], int(data[1]), data[2], data[3]
+            kcode = 2
+        else:
+            p0, p1, p2 = data
+            parity = 0
+            kcode = 0 if kind == "ecdsa" else 1
+        a = np.frombuffer(p0, np.uint8) if p0 else np.zeros(1, np.uint8)
+        b = np.frombuffer(p1, np.uint8) if p1 else np.zeros(1, np.uint8)
+        c = np.frombuffer(p2, np.uint8) if p2 else np.zeros(1, np.uint8)
+        L.nat_session_add_known(
+            self._ptr, kcode, parity & 1,
+            _u8p(a), len(p0), _u8p(b), len(p1), _u8p(c), len(p2),
+            1 if result else 0,
+        )
+
+    def take_records(self) -> List[Tuple[str, Tuple]]:
+        """Drain the records of the last verify_input call as
+        (kind, data) tuples shaped exactly like SigCheck.data."""
+        L = lib()
+        n = int(L.nat_session_records_count(self._ptr))
+        if n == 0:
+            return []
+        kinds = np.zeros(n, dtype=np.int32)
+        parities = np.zeros(n, dtype=np.int32)
+        lens = np.zeros(3 * n, dtype=np.int64)
+        L.nat_session_records_meta(
+            self._ptr, _i32p(kinds), _i32p(parities),
+            lens.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        )
+        total = int(L.nat_session_records_bytes(self._ptr))
+        blob = np.zeros(max(total, 1), dtype=np.uint8)
+        L.nat_session_records_data(self._ptr, _u8p(blob))
+        raw = blob.tobytes()
+        out: List[Tuple[str, Tuple]] = []
+        pos = 0
+        for i in range(n):
+            l0, l1, l2 = int(lens[3 * i]), int(lens[3 * i + 1]), int(lens[3 * i + 2])
+            p0 = raw[pos : pos + l0]
+            p1 = raw[pos + l0 : pos + l0 + l1]
+            p2 = raw[pos + l0 + l1 : pos + l0 + l1 + l2]
+            pos += l0 + l1 + l2
+            kind = _KIND_NAME[int(kinds[i])]
+            if kind == "tweak":
+                out.append((kind, (p0, int(parities[i]), p1, p2)))
+            else:
+                out.append((kind, (p0, p1, p2)))
+        return out
+
+    def verify_input(
+        self,
+        ntx: NativeTx,
+        n_in: int,
+        amount: int,
+        script_pubkey: bytes,
+        flags: int,
+        mode: int = MODE_DEFER,
+    ) -> Tuple[bool, int, int]:
+        """(ok, script_error_code, unknown_count); records via take_records."""
+        L = lib()
+        spk = (
+            np.frombuffer(script_pubkey, np.uint8)
+            if script_pubkey
+            else np.zeros(1, np.uint8)
+        )
+        serr = np.zeros(1, dtype=np.int32)
+        unk = np.zeros(1, dtype=np.int32)
+        ok = L.nat_verify_input(
+            self._ptr, ntx._ptr, n_in, amount, _u8p(spk), len(script_pubkey),
+            flags, mode, _i32p(serr), _i32p(unk),
+        )
+        return bool(ok), int(serr[0]), int(unk[0])
+
+
+class NativeSecp:
+    """Object surface over the native single-check verifies (drop-in for
+    the secp_host functions where a fast host-exact answer is wanted)."""
+
+    @staticmethod
+    def verify_ecdsa(pubkey: bytes, sig_der: bytes, msg32: bytes) -> bool:
+        L = lib()
+        assert L is not None and len(msg32) == 32
+        pk = np.frombuffer(pubkey, dtype=np.uint8) if pubkey else np.zeros(1, np.uint8)
+        sg = np.frombuffer(sig_der, dtype=np.uint8) if sig_der else np.zeros(1, np.uint8)
+        ms = np.frombuffer(msg32, dtype=np.uint8)
+        return bool(
+            L.nat_verify_ecdsa(_u8p(pk), len(pubkey), _u8p(sg), len(sig_der), _u8p(ms))
+        )
+
+    @staticmethod
+    def verify_schnorr(pubkey32: bytes, sig64: bytes, msg32: bytes) -> bool:
+        L = lib()
+        assert L is not None
+        if len(pubkey32) != 32 or len(sig64) != 64 or len(msg32) != 32:
+            return False
+        a = np.frombuffer(pubkey32, dtype=np.uint8)
+        b = np.frombuffer(sig64, dtype=np.uint8)
+        c = np.frombuffer(msg32, dtype=np.uint8)
+        return bool(L.nat_verify_schnorr(_u8p(a), _u8p(b), _u8p(c)))
+
+    @staticmethod
+    def tweak_add_check(
+        tweaked32: bytes, parity: int, internal32: bytes, tweak32: bytes
+    ) -> bool:
+        L = lib()
+        assert L is not None
+        if len(tweaked32) != 32 or len(internal32) != 32 or len(tweak32) != 32:
+            return False
+        a = np.frombuffer(tweaked32, dtype=np.uint8)
+        b = np.frombuffer(internal32, dtype=np.uint8)
+        c = np.frombuffer(tweak32, dtype=np.uint8)
+        return bool(L.nat_tweak_add_check(_u8p(a), parity & 1, _u8p(b), _u8p(c)))

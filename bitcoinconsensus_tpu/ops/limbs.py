@@ -490,9 +490,81 @@ def fe_pow_const(a, e: int):
     return acc
 
 
+def _sqr_n(x, n: int):
+    """n repeated squarings under fori_loop (body compiled once per call
+    site — Mosaic-lowerable, unlike a scan with stacked outputs)."""
+    from jax import lax
+
+    if n == 0:
+        return x
+    if n == 1:
+        return fe_sqr(x)
+    return lax.fori_loop(0, n, lambda i, acc: fe_sqr(acc), x)
+
+
+def fe_pow_runs(x, e: int):
+    """x^e for a static exponent whose binary form has long 1-runs (both
+    secp256k1 field exponents do: (p+1)/4 and p-2 are runs of 223 and 22
+    ones plus a short tail). Addition-chain over run blocks: the same
+    ~log2(e) squarings as the bit ladder but ~18 multiplies instead of
+    popcount(e) ~ 223/239 — the multiply count is what the bit ladder
+    wastes (`secp256k1/src/field_*_impl.h` uses the same structure; chain
+    derived independently). Exponent bookkeeping is asserted at trace
+    time, so a wrong chain cannot trace, let alone compile."""
+    assert e > 0
+    # rep[k] holds (value, exponent) with exponent == 2^k - 1.
+    rep = {1: (x, 1)}
+
+    def get_rep(k: int):
+        if k not in rep:
+            a = k // 2
+            b = k - a
+            va, ea = get_rep(a)
+            vb, eb = get_rep(b)
+            val = fe_mul(_sqr_n(va, b), vb)
+            ee = (ea << b) + eb
+            assert ee == (1 << k) - 1
+            rep[k] = (val, ee)
+        return rep[k]
+
+    runs = []  # (bit, length), MSB-first
+    for ch in bin(e)[2:]:
+        bit = int(ch)
+        if runs and runs[-1][0] == bit:
+            runs[-1][1] += 1
+        else:
+            runs.append([bit, 1])
+    assert runs[0][0] == 1
+    acc, e_acc = get_rep(runs[0][1])
+    pending = 0
+    for bit, length in runs[1:]:
+        if bit == 0:
+            pending += length
+            continue
+        blk, eb = get_rep(length)
+        acc = fe_mul(_sqr_n(acc, pending + length), blk)
+        e_acc = (e_acc << (pending + length)) + eb
+        pending = 0
+    acc = _sqr_n(acc, pending)
+    e_acc <<= pending
+    assert e_acc == e, "power chain bookkeeping broke"
+    return acc
+
+
 def fe_inv(a):
-    """a^(p-2) mod p (Fermat inverse; 0 -> 0)."""
+    """a^(p-2) mod p (Fermat inverse; 0 -> 0).
+
+    Scan-based ladder: ONE compiled body — the XLA-path form (CPU test
+    compiles stay fast). The Pallas kernel uses `fe_inv_chain` instead
+    (Mosaic cannot lower the scan, and compiles the chain's fori_loop
+    bodies cheaply)."""
     return fe_pow_const(a, P_INT - 2)
+
+
+def fe_inv_chain(a):
+    """Addition-chain Fermat inverse (~18 muls instead of ~239): the
+    Pallas-kernel form of fe_inv. Bit-identical results."""
+    return fe_pow_runs(a, P_INT - 2)
 
 
 def fe_batch_inv(a, zero_mask):
@@ -524,5 +596,12 @@ def fe_batch_inv(a, zero_mask):
 
 def fe_sqrt(a):
     """Candidate square root a^((p+1)/4) (p ≡ 3 mod 4). The caller must
-    check candidate^2 == a; for non-residues the candidate is garbage."""
+    check candidate^2 == a; for non-residues the candidate is garbage.
+    Scan-based (XLA path); the Pallas kernel uses `fe_sqrt_chain`."""
     return fe_pow_const(a, (P_INT + 1) // 4)
+
+
+def fe_sqrt_chain(a):
+    """Addition-chain sqrt candidate (~18 muls instead of ~223): the
+    Pallas-kernel form of fe_sqrt. Bit-identical results."""
+    return fe_pow_runs(a, (P_INT + 1) // 4)

@@ -65,9 +65,11 @@ from .limbs import (
     bytes_to_limbs,
     fe_add,
     fe_canon,
+    fe_inv_chain,
     fe_is_zero,
     fe_mul,
     fe_sqr,
+    fe_sqrt_chain,
     fe_sub,
     int_to_limbs,
     set_const_provider,
@@ -97,13 +99,6 @@ _CONST_ROWS = {
     np.asarray(_GY_LIMBS).tobytes(): 6,
 }
 
-# Square-and-multiply schedules (MSB-first, first bit consumed by init).
-_SQRT_BITS = np.asarray(
-    [int(c) for c in bin((P_INT + 1) // 4)[2:]][1:], dtype=np.int32
-)
-_INV_BITS = np.asarray([int(c) for c in bin(P_INT - 2)[2:]][1:], dtype=np.int32)
-
-
 def _const_col(vec, like):
     from .limbs import limb_const
 
@@ -112,7 +107,7 @@ def _const_col(vec, like):
     ).astype(like.dtype)
 
 
-def _tile_batch_inv(Z, inf_mask, ones, inv_bits_ref):
+def _tile_batch_inv(Z, inf_mask, ones):
     """Montgomery batch inverse across the tile's lane axis.
 
     Hillis-Steele prefix/suffix fe_mul trees (log2(tile) whole-tile muls
@@ -139,28 +134,15 @@ def _tile_batch_inv(Z, inf_mask, ones, inv_bits_ref):
             lane < T - d, fe_mul(suf, jnp.roll(suf, -d, axis=1)), suf
         )
         d *= 2
-    # Fermat chain on the grand product at width 128 (Mosaic mis-lowers
-    # field ops on width-1 vectors); only the last lane is the real total.
+    # Fermat chain (addition-chain fe_inv) on the grand product at width
+    # 128 (Mosaic mis-lowers field ops on width-1 vectors); only the last
+    # lane is the real total.
     w = min(128, T)
-    tinv_w = _pow_loop(pre[:, T - w :], inv_bits_ref, len(_INV_BITS))
+    tinv_w = fe_inv_chain(pre[:, T - w :])
     tinv = tinv_w[:, w - 1 :]  # (20, 1)
     left = jnp.where(lane == 0, ones, jnp.roll(pre, 1, axis=1))
     right = jnp.where(lane == T - 1, ones, jnp.roll(suf, -1, axis=1))
     return fe_mul(fe_mul(left, right), jnp.broadcast_to(tinv, Z.shape))
-
-
-def _pow_loop(x, bits_ref, nbits: int):
-    """x^(exponent encoded by the SMEM bit schedule, MSB-first, leading
-    bit implicit in the init) via square-and-multiply under fori_loop —
-    Mosaic compiles the body once; the per-step bit is a scalar SMEM
-    read (lax.scan with extensive inputs does not lower in Mosaic)."""
-
-    def body(i, acc):
-        acc = fe_sqr(acc)
-        bit = bits_ref[0, i]
-        return jnp.where(bit == 1, fe_mul(acc, x), acc)
-
-    return lax.fori_loop(0, nbits, body, x)
 
 
 def _kernel(
@@ -172,8 +154,6 @@ def _kernel(
     db2_ref,
     flags_ref,
     consts_ref,
-    sqrt_bits_ref,
-    inv_bits_ref,
     gx_ref,
     gy_ref,
     ok_ref,
@@ -199,8 +179,7 @@ def _kernel(
     try:
         _kernel_body(
             px_ref, t1_ref, t1n_ref, da_ref, db1_ref, db2_ref, flags_ref,
-            sqrt_bits_ref, inv_bits_ref, gx_ref, gy_ref, ok_ref,
-            tx_ref, ty_ref, tz_ref,
+            gx_ref, gy_ref, ok_ref, tx_ref, ty_ref, tz_ref,
         )
     finally:
         set_const_provider(prev)
@@ -214,8 +193,6 @@ def _kernel_body(
     db1_ref,
     db2_ref,
     flags_ref,
-    sqrt_bits_ref,
-    inv_bits_ref,
     gx_ref,
     gy_ref,
     ok_ref,
@@ -234,7 +211,7 @@ def _kernel_body(
     # -- lift P's y from (x, parity): y = sqrt(x^3 + 7), flip to parity --
     seven = _const_col(_SEVEN, px)
     rhs = fe_add(fe_mul(fe_sqr(px), px), seven)
-    ycand = fe_canon(_pow_loop(rhs, sqrt_bits_ref, len(_SQRT_BITS)))
+    ycand = fe_canon(fe_sqrt_chain(rhs))
     sq_ok = fe_is_zero(fe_sub(fe_mul(ycand, ycand), rhs))
     odd = (ycand[0] & 1) == 1
     yneg = fe_sub(jnp.zeros_like(ycand), ycand)
@@ -352,7 +329,7 @@ def _kernel_body(
     )
 
     # -- affine + accept -------------------------------------------------
-    zi = _tile_batch_inv(Z, inf_mask, ones, inv_bits_ref)
+    zi = _tile_batch_inv(Z, inf_mask, ones)
     zi2 = fe_sqr(zi)
     x = fe_canon(fe_mul(X, zi2))
     y = fe_canon(fe_mul(Y, fe_mul(zi2, zi)))
@@ -418,8 +395,6 @@ def verify_tiles(
     )
 
     consts = jnp.asarray(_CONST_TABLE)
-    sqrt_bits = jnp.asarray(_SQRT_BITS).reshape(1, -1)
-    inv_bits = jnp.asarray(_INV_BITS).reshape(1, -1)
 
     ok = pl.pallas_call(
         _kernel,
@@ -433,12 +408,6 @@ def verify_tiles(
             lane_block(GLV_WINDOWS),  # db2
             lane_block(6),  # flags
             shared(consts.shape),  # limb constant table
-            pl.BlockSpec(
-                sqrt_bits.shape, lambda i: (0, 0), memory_space=pltpu.SMEM
-            ),  # sqrt exponent schedule (scalar reads drive control flow)
-            pl.BlockSpec(
-                inv_bits.shape, lambda i: (0, 0), memory_space=pltpu.SMEM
-            ),  # inverse exponent schedule
             shared(gx.shape),  # G window table x
             shared(gy.shape),  # G window table y
         ],
@@ -450,5 +419,5 @@ def verify_tiles(
             pltpu.VMEM((16, NLIMB, tile), jnp.int32),  # P-table z
         ],
         interpret=interpret,
-    )(px, t1, t1n, da, db1, db2, flags, consts, sqrt_bits, inv_bits, gx, gy)
+    )(px, t1, t1n, da, db1, db2, flags, consts, gx, gy)
     return ok[0] != 0

@@ -28,7 +28,21 @@ import numpy as np
 
 import jax
 import jax.numpy as jnp
-from jax.experimental.shard_map import shard_map
+
+try:  # jax >= 0.4.35: the supported spelling
+    from jax import shard_map
+except ImportError:  # pragma: no cover - older jax
+    from jax.experimental.shard_map import shard_map
+# The varying-axes checker kwarg was renamed check_rep -> check_vma; key on
+# the actual signature, not the import location (mid-range jax exposes
+# jax.shard_map but still spells it check_rep).
+import inspect as _inspect
+
+_SHARD_MAP_KW = (
+    {"check_vma": False}
+    if "check_vma" in _inspect.signature(shard_map).parameters
+    else {"check_rep": False}
+)
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..crypto.jax_backend import SigCheck, TpuSecpVerifier, _verify_kernel
@@ -44,40 +58,70 @@ def make_mesh(n_devices: Optional[int] = None, axis: str = "batch") -> Mesh:
     return Mesh(np.asarray(devs), (axis,))
 
 
-def make_sharded_step(mesh: Mesh):
+def _pick_backend(use_pallas: bool):
+    """Per-shard kernel: the SAME backend selection as
+    TpuSecpVerifier._run_kernel, applied to the shard-local batch (so a
+    multi-chip deployment dispatches the Pallas production kernel on each
+    chip; CPU meshes and tile-indivisible shards fall back to XLA)."""
+
+    def local_kernel(fields, want_odd, parity_req, has_t2, neg1, neg2, valid):
+        if use_pallas:
+            from ..ops.pallas_kernel import LANE_TILE, verify_tiles
+
+            # Shard-local shapes are static at trace time inside shard_map.
+            if fields.shape[0] % LANE_TILE == 0:
+                return verify_tiles(
+                    fields, want_odd, parity_req, has_t2, neg1, neg2, valid
+                )
+        return _verify_kernel(
+            fields, want_odd, parity_req, has_t2, neg1, neg2, valid
+        )
+
+    return local_kernel
+
+
+def make_sharded_step(mesh: Mesh, use_pallas: Optional[bool] = None):
     """The full multichip verify step, jitted over `mesh`.
 
     Returns ``step(fields, want_odd, parity_req, has_t2, neg1, neg2,
     valid, live) -> (per_lane, all_ok)`` where inputs are batch-sharded,
-    `per_lane`
-    comes back batch-sharded, and `all_ok` is a replicated scalar produced
-    by a psum AND-reduction inside shard_map (the cross-chip collective —
-    the `CCheckQueueControl::Wait` analogue, checkqueue.h:139-142).
-    `live` marks real lanes: padding added to reach the batch shape is not
-    counted as a failure, while structurally-invalid real lanes are.
+    `per_lane` comes back batch-sharded, and `all_ok` is a replicated
+    scalar produced by a psum AND-reduction inside shard_map (the
+    cross-chip collective — the `CCheckQueueControl::Wait` analogue,
+    checkqueue.h:139-142). `live` marks real lanes: padding added to reach
+    the batch shape is not counted as a failure, while structurally-invalid
+    real lanes are. Each shard runs the production backend selection
+    (Pallas on TPU when the local tile divides; XLA otherwise).
     """
     axis = mesh.axis_names[0]
     fields_sharding = NamedSharding(mesh, P(axis, None, None))
     flat_sharding = NamedSharding(mesh, P(axis))
     replicated = NamedSharding(mesh, P())
+    if use_pallas is None:
+        use_pallas = all(d.platform == "tpu" for d in mesh.devices.flat)
+    local_kernel = _pick_backend(use_pallas)
 
-    def reduce_all(ok_local, live_local):
-        # all-valid <=> no live lane failed, on any shard.
-        failures = jnp.sum(jnp.where(live_local & ~ok_local, 1, 0))
-        return jax.lax.psum(failures, axis) == 0
-
-    reduce_sharded = shard_map(
-        reduce_all, mesh=mesh, in_specs=(P(axis), P(axis)), out_specs=P()
-    )
-
-    def step(fields, want_odd, parity_req, has_t2, neg1, neg2, valid, live):
-        per_lane = _verify_kernel(
+    def local_step(fields, want_odd, parity_req, has_t2, neg1, neg2, valid, live):
+        per_lane = local_kernel(
             fields, want_odd, parity_req, has_t2, neg1, neg2, valid
         )
-        return per_lane, reduce_sharded(per_lane, live)
+        # all-valid <=> no live lane failed, on any shard.
+        failures = jnp.sum(jnp.where(live & ~per_lane, 1, 0))
+        return per_lane, jax.lax.psum(failures, axis) == 0
 
+    # Varying-axes checking is off: the verify kernel's scan carries start
+    # as mesh-wide constants (infinity masks, G-table selects) and become
+    # shard-varying inside the loop — correct SPMD, but the strict
+    # varying-axes tracker rejects the carry-type mismatch.
+    sharded = shard_map(
+        local_step,
+        mesh=mesh,
+        in_specs=(P(axis, None, None),) + (P(axis),) * 7,
+        out_specs=(P(axis), P()),
+        **_SHARD_MAP_KW,
+    )
     return jax.jit(
-        step,
+        sharded,
         in_shardings=(fields_sharding,) + (flat_sharding,) * 7,
         out_shardings=(flat_sharding, replicated),
     )
@@ -94,7 +138,10 @@ class ShardedSecpVerifier(TpuSecpVerifier):
         # Batch sizes must divide evenly across the mesh: round min_batch up
         # to a multiple of n (doubling in _pad preserves divisibility).
         self._min_batch = -(-self._min_batch // n) * n
-        self._step = make_sharded_step(self.mesh)
+        tpu_mesh = all(d.platform == "tpu" for d in self.mesh.devices.flat)
+        self._step = make_sharded_step(
+            self.mesh, use_pallas=self._use_pallas and tpu_mesh
+        )
         self._verdict_acc = True
         self._dispatched = 0
 

@@ -1,0 +1,399 @@
+// C ABI for the native host core. Exports:
+//
+// - nat_prep_lanes: batch lane preparation for the TPU verify kernel —
+//   the native twin of TpuSecpVerifier._prep_lanes + _pack_lanes
+//   (crypto/jax_backend.py): structural pubkey parse, lax-DER, high-S
+//   normalization, Montgomery-batched s^-1 mod n, BIP340 challenge
+//   hashing, GLV lambda split, byte packing. One call per dispatch chunk.
+// - nat_verify_{ecdsa,schnorr}, nat_tweak_add_check: full host-exact
+//   single verifies (the scalar fallback path).
+// - nat_sha256 / nat_sha256d / nat_tagged_hash: hashing utilities.
+//
+// Layouts must stay bit-identical to the Python packers; the test suite
+// asserts equality lane by lane (tests/test_native.py).
+
+#include "eval.hpp"
+#include "secp.hpp"
+
+#include <cstring>
+
+using namespace nat;
+
+namespace {
+
+constexpr int KIND_ECDSA = 0;
+constexpr int KIND_SCHNORR = 1;
+constexpr int KIND_TWEAK = 2;
+
+struct Lane {
+    // mirrors jax_backend._Lane defaults
+    bool valid = false;
+    Sc a{};                    // fixed-base scalar
+    u64 b1[2] = {0, 0};        // |GLV half 1| little-endian
+    u64 b2[2] = {0, 0};
+    i32 neg1 = 0, neg2 = 0;
+    U256 px{};                 // raw x (defaults to G_X below)
+    i32 want_odd = 0;
+    U256 t1{};                 // raw target
+    i32 has_t2 = 0;
+    i32 parity = -1;
+};
+
+inline void set_b(Lane& ln, const Sc& b) {
+    GlvSplit sp = split_lambda(b);
+    if (!sp.ok) {  // cannot happen for k < n; defensive
+        ln.valid = false;
+        return;
+    }
+    ln.b1[0] = sp.a1[0];
+    ln.b1[1] = sp.a1[1];
+    ln.b2[0] = sp.a2[0];
+    ln.b2[1] = sp.a2[1];
+    ln.neg1 = sp.neg1;
+    ln.neg2 = sp.neg2;
+}
+
+inline const U256& GX_U256() {
+    static const U256 gx = [] {
+        static const u8 be[32] = {0x79, 0xBE, 0x66, 0x7E, 0xF9, 0xDC, 0xBB,
+                                  0xAC, 0x55, 0xA0, 0x62, 0x95, 0xCE, 0x87,
+                                  0x0B, 0x07, 0x02, 0x9B, 0xFC, 0xDB, 0x2D,
+                                  0xCE, 0x28, 0xD9, 0x59, 0xF2, 0x81, 0x5B,
+                                  0x16, 0xF8, 0x17, 0x98};
+        return u256_from_be(be);
+    }();
+    return gx;
+}
+
+// Structural half of pubkey parsing (jax_backend._host_parse_pubkey): no
+// square root — the y lift happens on device from (x, want_odd).
+inline bool host_parse_pubkey(Lane& ln, const u8* pk, i64 len) {
+    if (len == 33 && (pk[0] == 2 || pk[0] == 3)) {
+        U256 x = u256_from_be(pk + 1);
+        if (u256_cmp(x, FIELD_P()) >= 0) return false;
+        ln.px = x;
+        ln.want_odd = pk[0] == 3 ? 1 : 0;
+        return true;
+    }
+    if (len == 65 && (pk[0] == 4 || pk[0] == 6 || pk[0] == 7)) {
+        U256 xu = u256_from_be(pk + 1);
+        U256 yu = u256_from_be(pk + 33);
+        if (u256_cmp(xu, FIELD_P()) >= 0 || u256_cmp(yu, FIELD_P()) >= 0)
+            return false;
+        Fe x, y;
+        x.n = xu;
+        y.n = yu;
+        Fe rhs = fe_add(fe_mul(fe_sqr(x), x), fe_seven());
+        if (!fe_eq(fe_sqr(y), rhs)) return false;
+        bool y_odd = fe_is_odd(y);
+        if (pk[0] == 6 && y_odd) return false;
+        if (pk[0] == 7 && !y_odd) return false;
+        ln.px = xu;
+        ln.want_odd = y_odd ? 1 : 0;
+        return true;
+    }
+    return false;
+}
+
+}  // namespace
+
+extern "C" {
+
+int nat_version() { return 3; }
+
+void nat_sha256(const u8* data, i64 len, u8* out32) {
+    sha256(data, (size_t)len, out32);
+}
+
+void nat_sha256d(const u8* data, i64 len, u8* out32) {
+    sha256d(data, (size_t)len, out32);
+}
+
+void nat_tagged_hash(const u8* tag, i64 taglen, const u8* data, i64 len,
+                     u8* out32) {
+    u8 th[32];
+    sha256(tag, (size_t)taglen, th);
+    Sha256 h;
+    h.write(th, 32);
+    h.write(th, 32);
+    h.write(data, (size_t)len);
+    h.finalize(out32);
+}
+
+int nat_verify_ecdsa(const u8* pub, i64 publen, const u8* sig, i64 siglen,
+                     const u8* msg32) {
+    return verify_ecdsa(pub, (size_t)publen, sig, (size_t)siglen, msg32) ? 1 : 0;
+}
+
+int nat_verify_schnorr(const u8* pk32, const u8* sig64, const u8* msg32) {
+    return verify_schnorr(pk32, sig64, msg32) ? 1 : 0;
+}
+
+int nat_tweak_add_check(const u8* tweaked32, i32 parity, const u8* internal32,
+                        const u8* tweak32) {
+    return tweak_add_check(tweaked32, parity, internal32, tweak32) ? 1 : 0;
+}
+
+// Batch lane prep. Inputs:
+//   blob/offs: check i's parts are blob[offs[3i]..offs[3i+1]),
+//     blob[offs[3i+1]..offs[3i+2]), blob[offs[3i+2]..offs[3i+3]).
+//     ecdsa:   pubkey | sig_der | msg32
+//     schnorr: pk32   | sig64   | msg32
+//     tweak:   internal32 | tweak32 | tweaked32  (parity in kinds[i]>>8)
+//   kinds[i] & 0xff: 0 ecdsa, 1 schnorr, 2 tweak.
+//   n: number of checks.
+// Outputs (caller-allocated, only the first n lanes are written):
+//   fields: n*128 bytes — per lane (a | b1 | b2 | px | t1) little-endian
+//   want_odd/parity/has_t2/neg1/neg2/valid: n x i32 each
+void nat_prep_lanes(const u8* blob, const i64* offs, const i32* kinds, i32 n,
+                    u8* fields, i32* want_odd, i32* parity, i32* has_t2,
+                    i32* neg1, i32* neg2, i32* valid) {
+    // Pass 1: parse everything; collect ECDSA (r, s, m) for the batched
+    // inversion (jax_backend._batch_inv_mod_n shape: one Fermat total).
+    Lane* lanes = new Lane[n];
+    i32* ecdsa_idx = new i32[n];
+    Sc* ecdsa_r = new Sc[n];
+    Sc* ecdsa_s = new Sc[n];
+    Sc* ecdsa_m = new Sc[n];
+    i32 n_ecdsa = 0;
+
+    for (i32 i = 0; i < n; i++) {
+        Lane& ln = lanes[i];
+        ln.px = GX_U256();
+        const u8* p0 = blob + offs[3 * i];
+        i64 l0 = offs[3 * i + 1] - offs[3 * i];
+        const u8* p1 = blob + offs[3 * i + 1];
+        i64 l1 = offs[3 * i + 2] - offs[3 * i + 1];
+        const u8* p2 = blob + offs[3 * i + 2];
+        i64 l2 = offs[3 * i + 3] - offs[3 * i + 2];
+        int kind = kinds[i] & 0xff;
+        if (kind == KIND_ECDSA) {
+            if (l2 != 32) continue;
+            if (!host_parse_pubkey(ln, p0, l0)) continue;
+            Sc r, s;
+            if (!parse_der_lax(p1, (size_t)l1, &r, &s)) continue;
+            if (sc_is_high(s)) s = sc_neg(s);
+            if (sc_is_zero(r) || sc_is_zero(s)) continue;
+            ln.t1 = r.n;
+            U256 rn;
+            u64 carry = u256_add(rn, r.n, ORDER_N());
+            ln.has_t2 = (!carry && u256_cmp(rn, FIELD_P()) < 0) ? 1 : 0;
+            ln.valid = true;
+            ecdsa_idx[n_ecdsa] = i;
+            ecdsa_r[n_ecdsa] = r;
+            ecdsa_s[n_ecdsa] = s;
+            ecdsa_m[n_ecdsa] = sc_from_be(p2);
+            n_ecdsa++;
+        } else if (kind == KIND_SCHNORR) {
+            if (l0 != 32 || l1 != 64 || l2 != 32) continue;
+            U256 px = u256_from_be(p0);
+            if (u256_cmp(px, FIELD_P()) >= 0) continue;
+            U256 r_u = u256_from_be(p1);
+            U256 s_u = u256_from_be(p1 + 32);
+            if (u256_cmp(r_u, FIELD_P()) >= 0) continue;
+            if (u256_cmp(s_u, ORDER_N()) >= 0) continue;
+            u8 ch_in[96];
+            std::memcpy(ch_in, p1, 32);
+            std::memcpy(ch_in + 32, p0, 32);
+            std::memcpy(ch_in + 64, p2, 32);
+            u8 e_b[32];
+            BIP340_CHALLENGE().hash(ch_in, 96, e_b);
+            Sc e = sc_from_be(e_b);
+            ln.px = px;
+            ln.want_odd = 0;
+            ln.a.n = s_u;
+            set_b(ln, sc_neg(e));  // (n - e) mod n
+            ln.t1 = r_u;
+            ln.parity = 0;
+            ln.valid = true;
+        } else if (kind == KIND_TWEAK) {
+            if (l0 != 32 || l1 != 32 || l2 != 32) continue;
+            U256 px = u256_from_be(p0);
+            if (u256_cmp(px, FIELD_P()) >= 0) continue;
+            U256 t_u = u256_from_be(p1);
+            if (u256_cmp(t_u, ORDER_N()) >= 0) continue;
+            ln.px = px;
+            ln.want_odd = 0;
+            ln.a.n = t_u;
+            Sc one;
+            one.n = {{1, 0, 0, 0}};
+            set_b(ln, one);
+            ln.t1 = u256_from_be(p2);  // raw: >= p can never match
+            ln.parity = (kinds[i] >> 8) & 1;
+            ln.valid = true;
+        }
+    }
+
+    // Batched modular inverse of the ECDSA s values (Montgomery trick:
+    // one Fermat chain total).
+    if (n_ecdsa) {
+        Sc* prefix = new Sc[n_ecdsa];
+        Sc acc;
+        acc.n = {{1, 0, 0, 0}};
+        for (i32 j = 0; j < n_ecdsa; j++) {
+            acc = sc_mul(acc, ecdsa_s[j]);
+            prefix[j] = acc;
+        }
+        Sc inv = sc_inv(acc);
+        for (i32 j = n_ecdsa - 1; j >= 0; j--) {
+            Sc sinv = j ? sc_mul(inv, prefix[j - 1]) : inv;
+            inv = sc_mul(inv, ecdsa_s[j]);
+            Lane& ln = lanes[ecdsa_idx[j]];
+            ln.a = sc_mul(ecdsa_m[j], sinv);      // u1
+            set_b(ln, sc_mul(ecdsa_r[j], sinv));  // u2
+        }
+        delete[] prefix;
+    }
+
+    // Pack (jax_backend._pack_lanes layout).
+    for (i32 i = 0; i < n; i++) {
+        const Lane& ln = lanes[i];
+        u8* f = fields + (size_t)i * 128;
+        u256_to_le(ln.a.n, f);
+        for (int j = 0; j < 2; j++) {
+            u64 w = ln.b1[j];
+            for (int k = 0; k < 8; k++) f[32 + 8 * j + k] = u8(w >> (8 * k));
+            w = ln.b2[j];
+            for (int k = 0; k < 8; k++) f[48 + 8 * j + k] = u8(w >> (8 * k));
+        }
+        u256_to_le(ln.px, f + 64);
+        u256_to_le(ln.t1, f + 96);
+        want_odd[i] = ln.want_odd;
+        parity[i] = ln.parity;
+        has_t2[i] = ln.has_t2;
+        neg1[i] = ln.neg1;
+        neg2[i] = ln.neg2;
+        valid[i] = ln.valid ? 1 : 0;
+    }
+
+    delete[] lanes;
+    delete[] ecdsa_idx;
+    delete[] ecdsa_r;
+    delete[] ecdsa_s;
+    delete[] ecdsa_m;
+}
+
+// ---------------------------------------------------------------------------
+// Native interpreter surface: tx handles, deferral sessions, verify_input.
+// Twin of core/interpreter.verify_script + models/batch.py
+// DeferringSignatureChecker; see native/eval.hpp.
+
+void* nat_session_new() { return new Session(); }
+
+void nat_session_free(void* s) { delete static_cast<Session*>(s); }
+
+void nat_session_add_known(void* s, i32 kind, i32 parity, const u8* p0, i64 l0,
+                           const u8* p1, i64 l1, const u8* p2, i64 l2,
+                           i32 result) {
+    auto* sess = static_cast<Session*>(s);
+    Bytes a(p0, p0 + l0), b(p1, p1 + l1), c(p2, p2 + l2);
+    sess->known[Session::key(kind, parity, a, b, c)] = result != 0;
+}
+
+i32 nat_session_records_count(void* s) {
+    return (i32)static_cast<Session*>(s)->records.size();
+}
+
+// kinds/parities: n each; lens: 3n (p0, p1, p2 lengths per record).
+void nat_session_records_meta(void* s, i32* kinds, i32* parities, i64* lens) {
+    auto* sess = static_cast<Session*>(s);
+    for (size_t i = 0; i < sess->records.size(); i++) {
+        const Record& r = sess->records[i];
+        kinds[i] = r.kind;
+        parities[i] = r.parity;
+        lens[3 * i] = (i64)r.p0.size();
+        lens[3 * i + 1] = (i64)r.p1.size();
+        lens[3 * i + 2] = (i64)r.p2.size();
+    }
+}
+
+void nat_session_records_data(void* s, u8* blob) {
+    auto* sess = static_cast<Session*>(s);
+    size_t pos = 0;
+    for (const Record& r : sess->records) {
+        std::memcpy(blob + pos, r.p0.data(), r.p0.size());
+        pos += r.p0.size();
+        std::memcpy(blob + pos, r.p1.data(), r.p1.size());
+        pos += r.p1.size();
+        std::memcpy(blob + pos, r.p2.data(), r.p2.size());
+        pos += r.p2.size();
+    }
+}
+
+i64 nat_session_records_bytes(void* s) {
+    auto* sess = static_cast<Session*>(s);
+    i64 total = 0;
+    for (const Record& r : sess->records)
+        total += (i64)(r.p0.size() + r.p1.size() + r.p2.size());
+    return total;
+}
+
+void* nat_tx_parse(const u8* data, i64 len) {
+    try {
+        return tx_parse(data, (size_t)len);
+    } catch (const SerErr&) {
+        return nullptr;
+    }
+}
+
+void nat_tx_free(void* tx) { delete static_cast<NTx*>(tx); }
+
+i64 nat_tx_ser_size(void* tx) { return static_cast<NTx*>(tx)->ser_size; }
+
+i32 nat_tx_n_inputs(void* tx) {
+    return (i32)static_cast<NTx*>(tx)->vin.size();
+}
+
+// Precompute the tx-wide hash aggregates; spent outputs (one per input)
+// unlock BIP341. spk_offs has n+1 entries into spk_blob.
+void nat_tx_set_spent_outputs(void* txp, const i64* amounts, const u8* spk_blob,
+                              const i64* spk_offs, i32 n) {
+    auto* tx = static_cast<NTx*>(txp);
+    std::vector<NTxOut> spent((size_t)n);
+    for (i32 i = 0; i < n; i++) {
+        spent[i].value = amounts[i];
+        spent[i].spk.assign(spk_blob + spk_offs[i], spk_blob + spk_offs[i + 1]);
+    }
+    precompute(*tx, &spent);
+}
+
+void nat_tx_precompute(void* txp) {
+    precompute(*static_cast<NTx*>(txp), nullptr);
+}
+
+// Verify one input. mode 0 = deferring (records + oracle via sess),
+// mode 1 = exact (native curve math; sess may be NULL).
+// Returns 1 ok / 0 script-failed; *script_err gets the ScriptError code,
+// *unknown the count of oracle misses (deferring mode).
+i32 nat_verify_input(void* s, void* txp, i32 n_in, i64 amount, const u8* spk,
+                     i64 spk_len, i32 flags, i32 mode, i32* script_err,
+                     i32* unknown) {
+    auto* sess = static_cast<Session*>(s);
+    auto* tx = static_cast<NTx*>(txp);
+    // Defensive bounds check: the Python callers validate nIn first, but an
+    // out-of-range index must never reach the vin[] access below.
+    if (n_in < 0 || (size_t)n_in >= tx->vin.size()) {
+        *script_err = SE_UNKNOWN_ERROR;
+        *unknown = 0;
+        return 0;
+    }
+    if (sess) {
+        sess->records.clear();
+        sess->unknown = 0;
+    }
+    Checker checker;
+    checker.tx = tx;
+    checker.n_in = (size_t)n_in;
+    checker.amount = amount;
+    checker.mode = mode;
+    checker.sess = sess;
+    Bytes spk_b(spk, spk + spk_len);
+    EvalResult r = verify_script(tx->vin[(size_t)n_in].script_sig, spk_b,
+                                 tx->vin[(size_t)n_in].witness, (u32)flags,
+                                 checker);
+    *script_err = r.err;
+    *unknown = sess ? sess->unknown : 0;
+    return r.ok ? 1 : 0;
+}
+
+}  // extern "C"

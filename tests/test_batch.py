@@ -205,6 +205,34 @@ def test_batch_empty():
     assert verify_batch([]) == []
 
 
+def test_batch_transport_error_order_matches_single():
+    """A doubly-invalid item (trailing bytes AND out-of-range index) must
+    report ERR_TX_INDEX from batch and single alike — index before size,
+    the reference's check order (bitcoinconsensus.cpp:89-92)."""
+    txb, spk, amt = make_p2wpkh_spend("order")
+    combos = [
+        (txb + b"\x00", 5),   # both invalid -> ERR_TX_INDEX
+        (txb + b"\x00", 0),   # size only -> ERR_TX_SIZE_MISMATCH
+        (txb, 5),             # index only -> ERR_TX_INDEX
+        (txb, -1),            # negative: unsigned nIn semantics, no wraparound
+        (txb, 0),             # valid
+    ]
+    items = [
+        BatchItem(t, i, VERIFY_ALL_LIBCONSENSUS,
+                  spent_output_script=spk, amount=amt)
+        for t, i in combos
+    ]
+    got = verify_batch(items)
+    singles = [_single_verdict(it) for it in items]
+    for i, (res, (ok, err, _serr)) in enumerate(zip(got, singles)):
+        assert (res.ok, res.error) == (ok, err), f"combo {i}"
+    assert got[0].error == Error.ERR_TX_INDEX
+    assert got[1].error == Error.ERR_TX_SIZE_MISMATCH
+    assert got[2].error == Error.ERR_TX_INDEX
+    assert got[3].error == Error.ERR_TX_INDEX
+    assert got[4].ok
+
+
 def test_taproot_single_api_roundtrip():
     txb, spk, amt = make_p2tr_keypath_spend("roundtrip")
     api.verify_with_spent_outputs(txb, 0, [(amt, spk)])

@@ -295,6 +295,19 @@ def test_connect_block_premature_coinbase_spend():
     assert _connect(block2, coins2, T_HEIGHT).ok
 
 
+def test_connect_block_bip30_duplicate_txid_rejected():
+    """A tx whose txid already has unspent outputs in the view must be
+    rejected (Core's BIP30 HaveCoin scan) instead of overwriting the coin."""
+    coins, funded = make_funded_view(1)
+    tx = build_spend_tx(funded, fee=1000)
+    # Plant the tx's outputs as already-unspent coins (as if an identical
+    # txid had been connected before).
+    coins.add_tx(tx, HEIGHT - 50)
+    block = build_block([tx], T_HEIGHT, fees=1000)
+    res = _connect(block, coins, T_HEIGHT)
+    assert (res.ok, res.reason) == (False, "bad-txns-BIP30")
+
+
 def test_connect_block_value_conservation():
     coins, funded = make_funded_view(1)
     tx = build_spend_tx(funded, fee=1000)

@@ -1,0 +1,198 @@
+"""Native C++ interpreter vs the Python engine: byte-for-byte agreement.
+
+The Python engine (core/interpreter.py) is the executable spec — itself
+green on the four JSON consensus corpora and differentially tested against
+the compiled reference .so. The native engine (native/eval.hpp) must agree
+on (ok, ScriptError) for every script_tests.json vector, on random opcode
+soup, and on the full deferral protocol (records, oracle replay, unknown
+counts) that models/batch.py drives.
+"""
+
+import random
+
+import pytest
+
+from conftest import *  # noqa: F401,F403 (env setup)
+
+from bitcoinconsensus_tpu import native_bridge as NB
+from bitcoinconsensus_tpu.core import flags as F
+from bitcoinconsensus_tpu.core.interpreter import (
+    ScriptExecutionData,
+    TransactionSignatureChecker,
+    verify_script,
+)
+from bitcoinconsensus_tpu.core.script_error import ScriptError
+from bitcoinconsensus_tpu.core.sighash import PrecomputedTxData
+from bitcoinconsensus_tpu.core.tx import Tx, TxOut
+from bitcoinconsensus_tpu.models.batch import DeferringSignatureChecker
+
+from test_vectors_json import (
+    build_credit_tx,
+    build_spend_tx,
+    iter_script_tests,
+    parse_flags,
+)
+from bitcoinconsensus_tpu.utils.script_asm import parse_asm
+
+pytestmark = pytest.mark.skipif(
+    not NB.available(), reason="native library unavailable (no compiler?)"
+)
+
+
+def _native_verify(spend_raw, n_in, amount, spk, flags, spent_outputs=None,
+                   mode=NB.NativeSession.MODE_EXACT, session=None):
+    ntx = NB.NativeTx(spend_raw)
+    if spent_outputs is not None:
+        ntx.set_spent_outputs(spent_outputs)
+    else:
+        ntx.precompute()
+    sess = session if session is not None else NB.NativeSession()
+    ok, err, unk = sess.verify_input(ntx, n_in, amount, spk, flags, mode=mode)
+    return ok, err, unk, sess
+
+
+def test_script_vectors_native_exact():
+    """Every script_tests.json vector through the native engine in exact
+    mode must agree with the Python engine bit-for-bit."""
+    n_run = 0
+    failures = []
+    for idx, test, witness, value, pos in iter_script_tests():
+        script_sig = parse_asm(test[pos])
+        script_pubkey = parse_asm(test[pos + 1])
+        flags = parse_flags(test[pos + 2])
+        if flags & F.VERIFY_CLEANSTACK:
+            flags |= F.VERIFY_P2SH | F.VERIFY_WITNESS
+
+        credit = build_credit_tx(script_pubkey, value)
+        spend = build_spend_tx(script_sig, witness, credit)
+        checker = TransactionSignatureChecker(spend, 0, value, PrecomputedTxData(spend))
+        ok_py, err_py = verify_script(script_sig, script_pubkey, witness, flags, checker)
+
+        ok_nat, err_nat, _, _ = _native_verify(
+            spend.serialize(), 0, value, script_pubkey, flags
+        )
+        n_run += 1
+        if ok_nat != ok_py or err_nat != int(err_py):
+            failures.append(
+                f"[{idx}] {test[pos]!r}|{test[pos+1]!r}|{test[pos+2]}: "
+                f"py=({ok_py},{err_py.name}) nat=({ok_nat},{ScriptError(err_nat).name})"
+            )
+    assert not failures, f"{len(failures)}/{n_run}:\n" + "\n".join(failures[:20])
+    assert n_run > 1000
+
+
+def test_random_scripts_native_vs_python():
+    """Opcode soup through both engines (exact mode): agreement on garbage,
+    not just well-formed scripts."""
+    rng = random.Random(0xBEEF)
+    n = 0
+    for k in range(400):
+        spk = bytes(rng.randrange(256) for _ in range(rng.randrange(1, 60)))
+        ssig = bytes(rng.randrange(256) for _ in range(rng.randrange(0, 40)))
+        flags = F.LIBCONSENSUS_FLAGS if rng.random() < 0.7 else (
+            rng.getrandbits(17) & F.ALL_FLAG_BITS & ~F.VERIFY_TAPROOT
+        )
+        credit = build_credit_tx(spk, 0)
+        spend = build_spend_tx(ssig, [], credit)
+        checker = TransactionSignatureChecker(spend, 0, 0, PrecomputedTxData(spend))
+        ok_py, err_py = verify_script(ssig, spk, [], flags, checker)
+        ok_nat, err_nat, _, _ = _native_verify(spend.serialize(), 0, 0, spk, flags)
+        assert (ok_nat, err_nat) == (ok_py, int(err_py)), (
+            k, spk.hex(), ssig.hex(), flags, err_py.name, ScriptError(err_nat).name,
+        )
+        n += 1
+    assert n == 400
+
+
+def _defer_python(spend, n_in, amount, spk, flags, txdata, known=None):
+    checker = DeferringSignatureChecker(spend, n_in, amount, txdata, known=known)
+    ok, err = verify_script(
+        spend.vin[n_in].script_sig, spk, spend.vin[n_in].witness, flags, checker
+    )
+    return ok, err, checker
+
+
+def test_deferral_protocol_matches_python():
+    """The deferral seam: records, optimistic verdicts, oracle replay and
+    unknown counts must match the Python DeferringSignatureChecker on
+    real spends (P2WPKH ECDSA, P2WSH multisig, P2TR key/script path)."""
+    from test_batch import (
+        make_p2tr_keypath_spend,
+        make_p2tr_scriptpath_spend,
+        make_p2wpkh_spend,
+    )
+
+    cases = []
+    txb, spk, amt = make_p2wpkh_spend("nat-defer")
+    cases.append((txb, spk, amt, F.VERIFY_ALL_LIBCONSENSUS, None))
+    txb, spk, amt = make_p2tr_keypath_spend("nat-defer-key")
+    cases.append((txb, spk, amt, F.VERIFY_ALL_EXTENDED, [(amt, spk)]))
+    txb, spk, amt = make_p2tr_scriptpath_spend("nat-defer-script")
+    cases.append((txb, spk, amt, F.VERIFY_ALL_EXTENDED, [(amt, spk)]))
+
+    for txb, spk, amt, flags, spent in cases:
+        spend = Tx.deserialize(txb)
+        if spent is not None:
+            txdata = PrecomputedTxData(spend, [TxOut(a, s) for a, s in spent])
+        else:
+            txdata = PrecomputedTxData(spend)
+        ok_py, err_py, chk = _defer_python(spend, 0, amt, spk, flags, txdata)
+
+        ok_nat, err_nat, unk, sess = _native_verify(
+            txb, 0, amt, spk, flags, spent_outputs=spent,
+            mode=NB.NativeSession.MODE_DEFER,
+        )
+        recs = sess.take_records()
+        assert (ok_nat, err_nat) == (ok_py, int(err_py))
+        assert unk == chk.unknown
+        py_recs = [(c.kind, c.data) for c in chk.recorded]
+        assert recs == py_recs, (recs, py_recs)
+
+        # Oracle replay: feed back TRUE for every record -> exact verdict,
+        # zero unknowns, same on both engines.
+        known = {(c.kind, c.data): True for c in chk.recorded}
+        ok_py2, err_py2, chk2 = _defer_python(
+            spend, 0, amt, spk, flags, txdata, known=known
+        )
+        sess2 = NB.NativeSession()
+        for (kind, data), res in known.items():
+            sess2.add_known(kind, data, res)
+        ntx = NB.NativeTx(txb)
+        if spent is not None:
+            ntx.set_spent_outputs(spent)
+        else:
+            ntx.precompute()
+        ok_nat2, err_nat2, unk2 = sess2.verify_input(
+            ntx, 0, amt, spk, flags, mode=NB.NativeSession.MODE_DEFER
+        )
+        assert (ok_nat2, err_nat2, unk2) == (ok_py2, int(err_py2), chk2.unknown)
+        assert unk2 == 0
+
+        # Oracle replay with FALSE -> both engines fail identically.
+        known_f = {k: False for k in known}
+        ok_py3, err_py3, _ = _defer_python(
+            spend, 0, amt, spk, flags, txdata, known=known_f
+        )
+        sess3 = NB.NativeSession()
+        for (kind, data), res in known_f.items():
+            sess3.add_known(kind, data, res)
+        ok_nat3, err_nat3, _ = sess3.verify_input(
+            ntx, 0, amt, spk, flags, mode=NB.NativeSession.MODE_DEFER
+        )
+        assert (ok_nat3, err_nat3) == (ok_py3, int(err_py3))
+        assert not ok_nat3
+
+
+def test_tx_handle_transport_fields():
+    from test_batch import make_p2wpkh_spend
+
+    txb, spk, amt = make_p2wpkh_spend("nat-transport")
+    ntx = NB.NativeTx(txb)
+    tx = Tx.deserialize(txb)
+    assert ntx.n_inputs == len(tx.vin)
+    assert ntx.ser_size == len(tx.serialize())
+    with pytest.raises(ValueError):
+        NB.NativeTx(txb[:10])  # truncated -> deserialize failure
+    # trailing bytes parse fine but ser_size exposes the mismatch
+    ntx2 = NB.NativeTx(txb + b"\x00")
+    assert ntx2.ser_size == len(txb)

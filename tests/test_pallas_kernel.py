@@ -53,3 +53,57 @@ def test_pallas_matches_xla_kernel():
     assert (got == want).all(), (got, want)
     assert not want[3] and not want[5] and not want[2] and not want[4]
     assert want[0] and want[1]
+
+
+def test_pallas_production_shape_matches_xla():
+    """Equality at the PRODUCTION tile (LANE_TILE=512): multi-kind lanes
+    (ECDSA/Schnorr/tweak), adversarial corruptions of every flavor, and —
+    crucially — the w=128 Fermat narrowing in _tile_batch_inv, which the
+    tile=8 test can never reach (w=min(128, T))."""
+    import __graft_entry__ as ge
+    from bitcoinconsensus_tpu.crypto.jax_backend import (
+        SigCheck,
+        TpuSecpVerifier,
+        _verify_kernel,
+    )
+    from bitcoinconsensus_tpu.ops.pallas_kernel import LANE_TILE, verify_tiles
+
+    checks = ge._example_checks(LANE_TILE)
+    # Structurally-invalid lanes (host-rejected, valid=False): bad ECDSA
+    # pubkey prefix; short Schnorr pubkey.
+    d = checks[9].data
+    checks[9] = SigCheck("ecdsa", (b"\x05" + d[0][1:], d[1], d[2]))
+    d = checks[10].data
+    checks[10] = SigCheck("schnorr", (d[0][:31], d[1], d[2]))
+
+    v = TpuSecpVerifier(min_batch=LANE_TILE)
+    args = v._pack_lanes(v._prep_lanes(checks))
+    fields, want_odd, parity, has_t2, neg1, neg2, valid = (
+        np.array(a) for a in args
+    )
+    assert not valid[9] and not valid[10]
+    # Device-level corruptions across kinds (lane i: i%3==0 ECDSA,
+    # 1 Schnorr, 2 tweak).
+    fields[0, 3, 0] ^= 1  # ECDSA target
+    fields[1, 3, 0] ^= 1  # Schnorr target
+    fields[2, 3, 0] ^= 1  # tweak target
+    fields[3, 2, 0] ^= 1  # ECDSA pubkey x perturbed (likely non-residue)
+    want_odd[6] ^= 1  # ECDSA wrong y-lift parity
+    parity[4] ^= 1  # Schnorr R.y parity requirement flipped
+    neg1[12] ^= 1  # GLV half sign flip
+
+    want = np.asarray(
+        _verify_kernel(fields, want_odd, parity, has_t2, neg1, neg2, valid)
+    )
+    got = np.asarray(
+        verify_tiles(
+            fields, want_odd, parity, has_t2, neg1, neg2, valid,
+            tile=LANE_TILE, interpret=True,
+        )
+    )
+    assert (got == want).all(), np.nonzero(got != want)
+    bad = [0, 1, 2, 3, 4, 6, 9, 10, 12]
+    assert not want[bad].any(), want[bad]
+    mask = np.ones(LANE_TILE, dtype=bool)
+    mask[bad] = False
+    assert want[mask].all(), np.nonzero(~want & mask)
