@@ -132,6 +132,7 @@ class _Prepared:
     optimistic: Optional[Tuple[bool, ScriptError]] = None
     checks: List[SigCheck] = field(default_factory=list)
     ntx: Optional[object] = None  # native_bridge.NativeTx when native is on
+    wtxid: Optional[bytes] = None
 
 
 def _spent_memo_entry(item: BatchItem, spent_memo: Dict[int, Tuple]):
@@ -159,12 +160,60 @@ def _prepare(
     order (flags -> deserialize -> index -> size). PrecomputedTxData is
     built once per (tx, prevouts-digest) — the validation.cpp:1538-1549
     one-hash-pass-per-tx shape — and the digest keying means conflicting
-    prevout lists for the same tx can never share a cache entry."""
+    prevout lists for the same tx can never share a cache entry. With the
+    native core on (ntx_cache given), parse + transport checks + hash
+    precompute all happen in C++ and the Python Tx/PrecomputedTxData are
+    never built (they are only consumed by the Python fallback engine)."""
     prep = _Prepared()
     allowed = ALL_FLAG_BITS if item.spent_outputs is not None else LIBCONSENSUS_FLAGS
     if item.flags & ~allowed:
         prep.result = BatchResult(False, Error.ERR_INVALID_FLAGS)
         return prep
+
+    if ntx_cache is not None:
+        if item.spent_outputs is not None:
+            spent_outputs, digest = _spent_memo_entry(item, spent_memo)
+            key = (item.spending_tx, digest)
+        else:
+            spent_outputs = None
+            key = (item.spending_tx, None)
+        if key in ntx_cache:
+            ntx = ntx_cache[key]
+        else:
+            try:
+                ntx = native_bridge.NativeTx(item.spending_tx)
+                if item.spent_outputs is not None:
+                    ntx.set_spent_outputs(list(item.spent_outputs))
+                else:
+                    ntx.precompute()
+            except ValueError:
+                ntx = None
+            ntx_cache[key] = ntx
+        if ntx is None:
+            prep.result = BatchResult(False, Error.ERR_TX_DESERIALIZE)
+            return prep
+        if item.input_index < 0 or item.input_index >= ntx.n_inputs:
+            prep.result = BatchResult(False, Error.ERR_TX_INDEX)
+            return prep
+        if ntx.ser_size != len(item.spending_tx):
+            prep.result = BatchResult(False, Error.ERR_TX_SIZE_MISMATCH)
+            return prep
+        if spent_outputs is not None:
+            if len(spent_outputs) != ntx.n_inputs:
+                prep.result = BatchResult(False, Error.ERR_TX_INDEX)
+                return prep
+            prep.script_pubkey = spent_outputs[item.input_index].script_pubkey
+            prep.amount = spent_outputs[item.input_index].value
+        else:
+            if item.flags & VERIFY_TAPROOT:
+                prep.result = BatchResult(False, Error.ERR_AMOUNT_REQUIRED)
+                return prep
+            prep.script_pubkey = item.spent_output_script or b""
+            prep.amount = item.amount
+        prep.ntx = ntx
+        prep.wtxid = ntx.wtxid
+        return prep
+
     try:
         cached = tx_cache.get(item.spending_tx)
         if cached is None:
@@ -214,21 +263,7 @@ def _prepare(
         prep.script_pubkey = item.spent_output_script or b""
         prep.amount = item.amount
     prep.tx = tx
-    if ntx_cache is not None:
-        # Native tx handle, one per (tx, prevouts-digest) like txdata; the
-        # C++ side holds the parse + precomputed hash aggregates.
-        ntx = ntx_cache.get(tkey)
-        if ntx is None:
-            try:
-                ntx = native_bridge.NativeTx(item.spending_tx)
-                if item.spent_outputs is not None:
-                    ntx.set_spent_outputs(list(item.spent_outputs))
-                else:
-                    ntx.precompute()
-            except ValueError:  # pragma: no cover - python parse succeeded
-                ntx = None
-            ntx_cache[tkey] = ntx
-        prep.ntx = ntx
+    prep.wtxid = tx.wtxid
     return prep
 
 
@@ -270,7 +305,7 @@ def verify_batch(
     # interpreter and the device outright (validation.cpp:1529-1536).
     spent_digests: List[Optional[bytes]] = [None] * len(items)
     for idx, (item, prep) in enumerate(zip(items, preps)):
-        if prep.result is not None or prep.tx is None:
+        if prep.result is not None or prep.wtxid is None:
             continue
         if item.spent_outputs is not None:
             digest = _spent_memo_entry(item, spent_memo)[1]
@@ -280,7 +315,7 @@ def verify_batch(
             )
         spent_digests[idx] = digest
         if script_cache.contains_input(
-            prep.tx.wtxid, item.input_index, item.flags, digest
+            prep.wtxid, item.input_index, item.flags, digest
         ):
             prep.result = BatchResult.success()
 
@@ -417,7 +452,7 @@ def verify_batch(
         if ok:
             if spent_digests[idx] is not None:
                 script_cache.add_input(
-                    prep.tx.wtxid, item.input_index, item.flags, spent_digests[idx]
+                    prep.wtxid, item.input_index, item.flags, spent_digests[idx]
                 )
             out.append(BatchResult.success())
         else:

@@ -127,6 +127,7 @@ def lib() -> Optional[ctypes.CDLL]:
         L.nat_tx_ser_size.restype = ctypes.c_int64
         L.nat_tx_n_inputs.argtypes = [vp]
         L.nat_tx_n_inputs.restype = ctypes.c_int32
+        L.nat_tx_wtxid.argtypes = [vp, u8p]
         L.nat_tx_set_spent_outputs.argtypes = [vp, i64p, u8p, i64p, ctypes.c_int32]
         L.nat_tx_precompute.argtypes = [vp]
         L.nat_verify_input.argtypes = [
@@ -222,7 +223,7 @@ class NativeTx:
     """Parsed-transaction handle (native/interp.hpp NTx). Holds the wire
     parse and the tx-wide precomputed hash aggregates on the C++ side."""
 
-    __slots__ = ("_ptr", "n_inputs", "ser_size")
+    __slots__ = ("_ptr", "n_inputs", "ser_size", "_wtxid")
 
     def __init__(self, raw: bytes):
         L = lib()
@@ -234,6 +235,15 @@ class NativeTx:
         self._ptr = ptr
         self.n_inputs = int(L.nat_tx_n_inputs(ptr))
         self.ser_size = int(L.nat_tx_ser_size(ptr))
+        self._wtxid: Optional[bytes] = None
+
+    @property
+    def wtxid(self) -> bytes:
+        if self._wtxid is None:
+            out = np.zeros(32, dtype=np.uint8)
+            lib().nat_tx_wtxid(self._ptr, _u8p(out))
+            self._wtxid = out.tobytes()
+        return self._wtxid
 
     def __del__(self):
         L = lib()

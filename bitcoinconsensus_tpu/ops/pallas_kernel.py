@@ -44,7 +44,6 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from .curve import (
-    GLV_WINDOWS,
     G_WINDOWS,
     G_WINDOW_BITS,
     _digits,
@@ -78,6 +77,29 @@ from .limbs import (
 __all__ = ["verify_tiles", "LANE_TILE"]
 
 LANE_TILE = 512  # lanes per kernel instance (4 VPU lane groups)
+
+# Signed 5-bit windows over the 128-bit GLV halves: 26 windows of
+# (5 doublings + 2 complete adds) instead of the XLA path's 32 x (4 + 2) —
+# twelve fewer complete adds per lane for two extra doublings. Digits are
+# recoded to [-16, 15] in the XLA preamble (_signed_digits128); the table
+# holds {1..16}·P and signs negate the selected y.
+SGLV_WINDOWS = 26
+SGLV_WIDTH = 5
+
+
+def _signed_digits128(limbs10):
+    """(10, B) limbs of a value < 2^128 -> ((26, B) |digit|, (26, B) sign)
+    with digit ∈ [-16, 15] and sum digit_i·32^i equal to the value. The
+    top window never carries out (bits 125..127 + carry <= 8 < 16)."""
+    raw = _digits128(limbs10, count=SGLV_WINDOWS, width=SGLV_WIDTH)
+
+    def step(carry, w):
+        t = w + carry
+        neg = t >= 16
+        return neg.astype(jnp.int32), jnp.where(neg, t - 32, t)
+
+    _, ds = lax.scan(step, jnp.zeros_like(raw[0]), raw)
+    return jnp.abs(ds), (ds < 0).astype(jnp.int32)
 
 from ..crypto.secp_host import N as _N_INT  # noqa: E402 (cycle-free)
 
@@ -151,7 +173,9 @@ def _kernel(
     t1n_ref,
     da_ref,
     db1_ref,
+    ds1_ref,
     db2_ref,
+    ds2_ref,
     flags_ref,
     consts_ref,
     gx_ref,
@@ -164,8 +188,8 @@ def _kernel(
     """One LANE_TILE-wide verify tile, entirely in VMEM.
 
     flags rows: 0=want_odd, 1=parity_req, 2=has_t2, 3=valid, 4=neg1,
-    5=neg2. tx/ty/tz: (16, 20, tile) VMEM scratch for the per-lane P
-    table.
+    5=neg2. db/ds: signed-window digit magnitudes/signs (26, tile).
+    tx/ty/tz: (16, 20, tile) VMEM scratch for the per-lane {1..16}·P table.
     """
 
     def provider(arr):
@@ -178,8 +202,8 @@ def _kernel(
     prev = set_const_provider(provider)
     try:
         _kernel_body(
-            px_ref, t1_ref, t1n_ref, da_ref, db1_ref, db2_ref, flags_ref,
-            gx_ref, gy_ref, ok_ref, tx_ref, ty_ref, tz_ref,
+            px_ref, t1_ref, t1n_ref, da_ref, db1_ref, ds1_ref, db2_ref,
+            ds2_ref, flags_ref, gx_ref, gy_ref, ok_ref, tx_ref, ty_ref, tz_ref,
         )
     finally:
         set_const_provider(prev)
@@ -191,7 +215,9 @@ def _kernel_body(
     t1n_ref,
     da_ref,
     db1_ref,
+    ds1_ref,
     db2_ref,
+    ds2_ref,
     flags_ref,
     gx_ref,
     gy_ref,
@@ -205,8 +231,8 @@ def _kernel_body(
     parity_req = flags_ref[1, :]
     has_t2 = flags_ref[2, :]
     valid = flags_ref[3, :] != 0
-    neg1 = flags_ref[4, :] == 1
-    neg2 = flags_ref[5, :] == 1
+    neg1i = flags_ref[4, :]
+    neg2i = flags_ref[5, :]
 
     # -- lift P's y from (x, parity): y = sqrt(x^3 + 7), flip to parity --
     seven = _const_col(_SEVEN, px)
@@ -226,13 +252,12 @@ def _kernel_body(
     px = jnp.where(valid[None], px, gxb)
     py = jnp.where(valid[None], py, gyb)
 
-    # -- per-lane Jacobian table {0..15}·P into VMEM scratch ------------
+    # -- per-lane Jacobian table {1..16}·P into VMEM scratch ------------
     # (fori_loop + dynamic scratch store; Mosaic cannot lower a scan with
-    # per-step stacked outputs.)
+    # per-step stacked outputs.) Row r holds (r+1)·P — signed digits never
+    # select zero (handled by the add's zero-mask), so no infinity row.
     ones = _const_col(_ONE, px)
-    inf = _inf_like(px)
-    tx_ref[0], ty_ref[0], tz_ref[0] = inf
-    tx_ref[1], ty_ref[1], tz_ref[1] = px, py, ones
+    tx_ref[0], ty_ref[0], tz_ref[0] = px, py, ones
 
     def tstep(k, carry):
         # carry = k·P, never infinity for on-curve P (inf1=False).
@@ -241,18 +266,16 @@ def _kernel_body(
         tx_ref[k], ty_ref[k], tz_ref[k] = nxt
         return nxt
 
-    lax.fori_loop(2, 16, tstep, (px, py, ones))
+    lax.fori_loop(1, 16, tstep, (px, py, ones))
     TX, TY, TZ = tx_ref[:], ty_ref[:], tz_ref[:]
 
-    # -- (±b1 ± lambda·b2)·P: 32 GLV windows of 4 doublings + 2 complete
-    # adds (lambda*(x,y) = (beta*x, y); signed halves negate the selected
-    # y) — half the doublings of the non-GLV 64-window ladder.
-    k16 = jax.lax.broadcasted_iota(jnp.int32, (16, 1, 1), 0)
+    # -- (±b1 ± lambda·b2)·P: 26 signed 5-bit windows of 5 doublings + 2
+    # complete adds (lambda*(x,y) = (beta*x, y); digit signs xor the GLV
+    # half signs and negate the selected y).
+    k16 = jax.lax.broadcasted_iota(jnp.int32, (16, 1, 1), 0) + 1
     beta = jnp.broadcast_to(
         _const_col(_BETA_LIMBS, px)[:, :1], px.shape
     ).astype(px.dtype)
-    n1 = neg1[None]
-    n2 = neg2[None]
 
     # Infinity masks ride the fori_loop carries as int32 0/1 — Mosaic
     # cannot lower i1 vectors through loop boundaries.
@@ -260,26 +283,29 @@ def _kernel_body(
         X, Y, Z, r_inf32 = carry
         r_inf = r_inf32 == 1
         R = (X, Y, Z)
-        w = GLV_WINDOWS - 1 - i
+        w = SGLV_WINDOWS - 1 - i
         R = jacobian_double(*R)  # doublings preserve infinity
         R = jacobian_double(*R)
         R = jacobian_double(*R)
         R = jacobian_double(*R)
+        R = jacobian_double(*R)
         d1 = db1_ref[w]  # ref-indexed dynamic VMEM load, (tile,)
+        s1 = (ds1_ref[w] ^ neg1i)[None]
         oh = (d1[None, None, :] == k16).astype(jnp.int32)  # (16, 1, T)
         selx = jnp.sum(TX * oh, axis=0)
         sely = jnp.sum(TY * oh, axis=0)
         selz = jnp.sum(TZ * oh, axis=0)
-        sely = jnp.where(n1, fe_sub(jnp.zeros_like(sely), sely), sely)
+        sely = jnp.where(s1 == 1, fe_sub(jnp.zeros_like(sely), sely), sely)
         *R, r_inf = jacobian_add_complete(
             *R, selx, sely, selz, d1 == 0, inf1=r_inf
         )
         d2 = db2_ref[w]
+        s2 = (ds2_ref[w] ^ neg2i)[None]
         oh = (d2[None, None, :] == k16).astype(jnp.int32)
         selx = fe_mul(jnp.sum(TX * oh, axis=0), beta)
         sely = jnp.sum(TY * oh, axis=0)
         selz = jnp.sum(TZ * oh, axis=0)
-        sely = jnp.where(n2, fe_sub(jnp.zeros_like(sely), sely), sely)
+        sely = jnp.where(s2 == 1, fe_sub(jnp.zeros_like(sely), sely), sely)
         X, Y, Z, r_inf = jacobian_add_complete(
             *R, selx, sely, selz, d2 == 0, inf1=r_inf
         )
@@ -287,7 +313,7 @@ def _kernel_body(
 
     all_inf = jnp.ones(px.shape[1:], dtype=jnp.int32)
     X, Y, Z, r_inf32 = lax.fori_loop(
-        0, GLV_WINDOWS, wbody, _inf_like(px) + (all_inf,)
+        0, SGLV_WINDOWS, wbody, _inf_like(px) + (all_inf,)
     )
     r_inf = r_inf32 == 1
     R = (X, Y, Z)
@@ -357,15 +383,16 @@ def verify_tiles(
     B = fields.shape[0]
     assert B % tile == 0, (B, tile)
 
-    # XLA preamble: byte unpack, window digits, r+n secondary target.
+    # XLA preamble: byte unpack, window digits (signed 5-bit for the GLV
+    # halves), r+n secondary target.
     a = bytes_to_limbs(fields[:, 0])
     b1 = bytes_to_limbs(fields[:, 1, :16], nlimb=10)  # GLV halves
     b2 = bytes_to_limbs(fields[:, 1, 16:], nlimb=10)
     px = bytes_to_limbs(fields[:, 2])
     t1 = bytes_to_limbs(fields[:, 3])
     da = _digits(a, G_WINDOW_BITS, G_WINDOWS)  # (32, B)
-    db1 = _digits128(b1)  # (32, B)
-    db2 = _digits128(b2)  # (32, B)
+    db1, ds1 = _signed_digits128(b1)  # (26, B) each
+    db2, ds2 = _signed_digits128(b2)
     nl = _const_col(_N_LIMBS, t1)
     # t1 ships RAW (exact 13-bit limbs from bytes): a target >= p must
     # never equal a canonical x, so it is NOT reduced. t1+n is only used
@@ -404,8 +431,10 @@ def verify_tiles(
             lane_block(NLIMB),  # t1 (raw)
             lane_block(NLIMB),  # t1 + n (canonical)
             lane_block(G_WINDOWS),  # da
-            lane_block(GLV_WINDOWS),  # db1
-            lane_block(GLV_WINDOWS),  # db2
+            lane_block(SGLV_WINDOWS),  # db1 magnitudes
+            lane_block(SGLV_WINDOWS),  # ds1 signs
+            lane_block(SGLV_WINDOWS),  # db2 magnitudes
+            lane_block(SGLV_WINDOWS),  # ds2 signs
             lane_block(6),  # flags
             shared(consts.shape),  # limb constant table
             shared(gx.shape),  # G window table x
@@ -419,5 +448,5 @@ def verify_tiles(
             pltpu.VMEM((16, NLIMB, tile), jnp.int32),  # P-table z
         ],
         interpret=interpret,
-    )(px, t1, t1n, da, db1, db2, flags, consts, gx, gy)
+    )(px, t1, t1n, da, db1, ds1, db2, ds2, flags, consts, gx, gy)
     return ok[0] != 0

@@ -348,7 +348,10 @@ struct NTx {
 };
 
 // Exact mirror of UnserializeTransaction (transaction.h:187-224 /
-// core/tx.py _deserialize_from). Throws SerErr.
+// core/tx.py _deserialize_from). Throws SerErr. Vectors grow
+// INCREMENTALLY (one entry per parsed element, each consuming >= 1 input
+// byte) — never pre-sized from the attacker-claimed CompactSize, so a
+// tiny malformed tx cannot demand a multi-GB allocation.
 inline NTx* tx_parse(const u8* data, size_t len) {
     Reader r(data, len);
     auto tx = std::make_unique<NTx>();
@@ -362,36 +365,35 @@ inline NTx* tx_parse(const u8* data, size_t len) {
         in.script_sig = r.read_string();
         in.sequence = r.read_u32();
     };
-    tx->vin.resize((size_t)n_vin);
-    for (auto& in : tx->vin) read_txin(in);
+    auto read_vin = [&](u64 n) {
+        for (u64 i = 0; i < n; i++) {
+            tx->vin.emplace_back();
+            read_txin(tx->vin.back());
+        }
+    };
+    auto read_vout = [&](u64 n) {
+        for (u64 i = 0; i < n; i++) {
+            tx->vout.emplace_back();
+            tx->vout.back().value = r.read_i64();
+            tx->vout.back().spk = r.read_string();
+        }
+    };
+    read_vin(n_vin);
     if (tx->vin.empty()) {
         flags = r.read_u8();
         if (flags != 0) {
-            n_vin = r.read_compact_size();
-            tx->vin.resize((size_t)n_vin);
-            for (auto& in : tx->vin) read_txin(in);
-            u64 n_vout = r.read_compact_size();
-            tx->vout.resize((size_t)n_vout);
-            for (auto& out : tx->vout) {
-                out.value = r.read_i64();
-                out.spk = r.read_string();
-            }
+            read_vin(r.read_compact_size());
+            read_vout(r.read_compact_size());
         }
     } else {
-        u64 n_vout = r.read_compact_size();
-        tx->vout.resize((size_t)n_vout);
-        for (auto& out : tx->vout) {
-            out.value = r.read_i64();
-            out.spk = r.read_string();
-        }
+        read_vout(r.read_compact_size());
     }
     if (flags & 1) {
         flags ^= 1;
         bool any = false;
         for (auto& in : tx->vin) {
             u64 n = r.read_compact_size();
-            in.witness.resize((size_t)n);
-            for (auto& w : in.witness) w = r.read_string();
+            for (u64 i = 0; i < n; i++) in.witness.push_back(r.read_string());
             if (n) any = true;
         }
         if (!any) throw SerErr("Superfluous witness record");

@@ -53,20 +53,10 @@ inline void set_b(Lane& ln, const Sc& b) {
     ln.neg2 = sp.neg2;
 }
 
-inline const U256& GX_U256() {
-    static const U256 gx = [] {
-        static const u8 be[32] = {0x79, 0xBE, 0x66, 0x7E, 0xF9, 0xDC, 0xBB,
-                                  0xAC, 0x55, 0xA0, 0x62, 0x95, 0xCE, 0x87,
-                                  0x0B, 0x07, 0x02, 0x9B, 0xFC, 0xDB, 0x2D,
-                                  0xCE, 0x28, 0xD9, 0x59, 0xF2, 0x81, 0x5B,
-                                  0x16, 0xF8, 0x17, 0x98};
-        return u256_from_be(be);
-    }();
-    return gx;
-}
-
 // Structural half of pubkey parsing (jax_backend._host_parse_pubkey): no
-// square root — the y lift happens on device from (x, want_odd).
+// square root for compressed keys — the y lift happens on device from
+// (x, want_odd); the 65-byte form shares parse_uncompressed_pubkey with
+// the host-exact verify path.
 inline bool host_parse_pubkey(Lane& ln, const u8* pk, i64 len) {
     if (len == 33 && (pk[0] == 2 || pk[0] == 3)) {
         U256 x = u256_from_be(pk + 1);
@@ -76,20 +66,10 @@ inline bool host_parse_pubkey(Lane& ln, const u8* pk, i64 len) {
         return true;
     }
     if (len == 65 && (pk[0] == 4 || pk[0] == 6 || pk[0] == 7)) {
-        U256 xu = u256_from_be(pk + 1);
-        U256 yu = u256_from_be(pk + 33);
-        if (u256_cmp(xu, FIELD_P()) >= 0 || u256_cmp(yu, FIELD_P()) >= 0)
-            return false;
         Fe x, y;
-        x.n = xu;
-        y.n = yu;
-        Fe rhs = fe_add(fe_mul(fe_sqr(x), x), fe_seven());
-        if (!fe_eq(fe_sqr(y), rhs)) return false;
-        bool y_odd = fe_is_odd(y);
-        if (pk[0] == 6 && y_odd) return false;
-        if (pk[0] == 7 && !y_odd) return false;
-        ln.px = xu;
-        ln.want_odd = y_odd ? 1 : 0;
+        if (!parse_uncompressed_pubkey(pk, &x, &y)) return false;
+        ln.px = x.n;
+        ln.want_odd = fe_is_odd(y) ? 1 : 0;
         return true;
     }
     return false;
@@ -159,7 +139,7 @@ void nat_prep_lanes(const u8* blob, const i64* offs, const i32* kinds, i32 n,
 
     for (i32 i = 0; i < n; i++) {
         Lane& ln = lanes[i];
-        ln.px = GX_U256();
+        ln.px = GEN().x.n;  // invalid-lane default matches _Lane (G_X)
         const u8* p0 = blob + offs[3 * i];
         i64 l0 = offs[3 * i + 1] - offs[3 * i];
         const u8* p1 = blob + offs[3 * i + 1];
@@ -331,9 +311,15 @@ i64 nat_session_records_bytes(void* s) {
 void* nat_tx_parse(const u8* data, i64 len) {
     try {
         return tx_parse(data, (size_t)len);
-    } catch (const SerErr&) {
+    } catch (...) {  // SerErr, bad_alloc, ... — never cross the C ABI
         return nullptr;
     }
+}
+
+void nat_tx_wtxid(void* txp, u8* out32) {
+    auto* tx = static_cast<NTx*>(txp);
+    Bytes b = tx->serialize(true);
+    sha256d(b.data(), b.size(), out32);
 }
 
 void nat_tx_free(void* tx) { delete static_cast<NTx*>(tx); }

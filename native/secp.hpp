@@ -653,23 +653,35 @@ inline bool lift_x(const U256& x_u, bool odd, Ge* out) {
     return true;
 }
 
+// Structural + on-curve validation of the 65-byte uncompressed/hybrid
+// form (eckey_impl.h parse rules incl. the 0x06/0x07 parity commitment).
+// Shared by the host-exact verify path and the lane-prep path so the
+// hybrid rules can never diverge between them.
+inline bool parse_uncompressed_pubkey(const u8* data, Fe* x_out, Fe* y_out) {
+    U256 xu = u256_from_be(data + 1);
+    U256 yu = u256_from_be(data + 33);
+    if (u256_cmp(xu, FIELD_P()) >= 0 || u256_cmp(yu, FIELD_P()) >= 0)
+        return false;
+    Fe x, y;
+    x.n = xu;
+    y.n = yu;
+    Fe rhs = fe_add(fe_mul(fe_sqr(x), x), fe_seven());
+    if (!fe_eq(fe_sqr(y), rhs)) return false;
+    bool y_odd = fe_is_odd(y);
+    if (data[0] == 6 && y_odd) return false;
+    if (data[0] == 7 && !y_odd) return false;
+    *x_out = x;
+    *y_out = y;
+    return true;
+}
+
 inline bool parse_pubkey(const u8* data, size_t len, Ge* out) {
     if (len == 33 && (data[0] == 2 || data[0] == 3)) {
         return lift_x(u256_from_be(data + 1), data[0] == 3, out);
     }
     if (len == 65 && (data[0] == 4 || data[0] == 6 || data[0] == 7)) {
-        U256 xu = u256_from_be(data + 1);
-        U256 yu = u256_from_be(data + 33);
-        if (u256_cmp(xu, FIELD_P()) >= 0 || u256_cmp(yu, FIELD_P()) >= 0)
-            return false;
         Fe x, y;
-        x.n = xu;
-        y.n = yu;
-        Fe rhs = fe_add(fe_mul(fe_sqr(x), x), fe_seven());
-        if (!fe_eq(fe_sqr(y), rhs)) return false;
-        bool y_odd = fe_is_odd(y);
-        if (data[0] == 6 && y_odd) return false;
-        if (data[0] == 7 && !y_odd) return false;
+        if (!parse_uncompressed_pubkey(data, &x, &y)) return false;
         out->x = x;
         out->y = y;
         out->infinity = false;

@@ -22,7 +22,9 @@ def main() -> None:
     sys.path.insert(0, os.path.dirname(__file__))
     from bitcoinconsensus_tpu.crypto.jax_backend import TpuSecpVerifier
 
-    verifier = TpuSecpVerifier(min_batch=8192, chunk=8192)
+    # A ~4k-sigop block pads to ONE 4096-lane dispatch (VERDICT r2: don't
+    # pad a 4k-check block to 8192); oracle rounds pad to small shapes.
+    verifier = TpuSecpVerifier(min_batch=512, chunk=4096)
     secs, n_inputs, n_txs = bench_block_replay(verifier)
     print(
         json.dumps(

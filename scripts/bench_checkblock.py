@@ -1,0 +1,57 @@
+"""Deserialize + CheckBlock on real mainnet block 413567.
+
+The exact workload of the reference's `src/bench/checkblock.cpp:17-45`
+(block fixture at `depend/bitcoin/src/bench/data/block413567.raw`,
+loaded read-only). Host-only: no device dispatch — CheckBlock is
+context-free (no UTXO set), matching the reference bench's scope.
+Prints one JSON line with both phases.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+BLOCK_PATH = os.path.join(
+    os.environ.get("BITCOIN_REFERENCE_ROOT", "/root/reference"),
+    "depend", "bitcoin", "src", "bench", "data", "block413567.raw",
+)
+
+
+def main() -> None:
+    from bitcoinconsensus_tpu.core.block import Block, check_block
+
+    with open(BLOCK_PATH, "rb") as f:
+        raw = f.read()
+
+    deser, check = [], []
+    for _ in range(5):
+        t0 = time.perf_counter()
+        block = Block.deserialize(raw)
+        deser.append(time.perf_counter() - t0)
+        t0 = time.perf_counter()
+        ok, reason = check_block(block)
+        check.append(time.perf_counter() - t0)
+        assert ok, reason
+
+    print(
+        json.dumps(
+            {
+                "metric": "checkblock_413567",
+                "value": round((min(deser) + min(check)) * 1000, 2),
+                "unit": "ms",
+                "deserialize_ms": round(min(deser) * 1000, 2),
+                "check_ms": round(min(check) * 1000, 2),
+                "txs": len(block.vtx),
+                "bytes": len(raw),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -183,6 +183,34 @@ def test_deferral_protocol_matches_python():
         assert not ok_nat3
 
 
+def test_malformed_tx_huge_claimed_counts():
+    """A tiny tx claiming ~33M inputs must fail cleanly (ValueError ->
+    ERR_TX_DESERIALIZE), never pre-allocate gigabytes or abort the
+    process; agreement with the Python codec."""
+    import struct
+
+    from bitcoinconsensus_tpu import api
+    from bitcoinconsensus_tpu.core.serialize import SerializationError
+
+    evil = struct.pack("<i", 1) + b"\xfe" + struct.pack("<I", 0x01FFFFFF)
+    with pytest.raises(ValueError):
+        NB.NativeTx(evil)
+    with pytest.raises(SerializationError):
+        Tx.deserialize(evil)
+    with pytest.raises(api.ConsensusError) as ei:
+        api.verify(b"\x51", 0, evil, 0)
+    assert ei.value.code == api.Error.ERR_TX_DESERIALIZE
+    # witness-count variant: valid 1-input skeleton, huge witness count
+    evil2 = (
+        struct.pack("<i", 1) + b"\x00\x01" + b"\x01" + b"\x00" * 36 + b"\x00"
+        + b"\xff\xff\xff\xff" + b"\x00" + b"\xfe" + struct.pack("<I", 0x01FFFFFF)
+    )
+    with pytest.raises(ValueError):
+        NB.NativeTx(evil2)
+    with pytest.raises(SerializationError):
+        Tx.deserialize(evil2)
+
+
 def test_tx_handle_transport_fields():
     from test_batch import make_p2wpkh_spend
 

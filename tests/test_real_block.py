@@ -1,0 +1,65 @@
+"""Real mainnet block 413567 through the block layer.
+
+The reference benches deserialize+CheckBlock on this exact block
+(`depend/bitcoin/src/bench/data/block413567.raw`, used by
+`src/bench/checkblock.cpp:17-45`). Loaded read-only from the reference
+checkout (same policy as the JSON consensus vectors); pins the codec,
+merkle tree, PoW check and CheckBlock rules against reality instead of
+our own generator. Script replay needs the UTXO set (not available to a
+pure library) — exactly the scope of the reference's own bench.
+"""
+
+import os
+
+import pytest
+
+from conftest import *  # noqa: F401,F403 (env setup)
+from conftest import REFERENCE_ROOT
+
+from bitcoinconsensus_tpu.core.block import (
+    Block,
+    block_merkle_root,
+    check_block,
+    check_proof_of_work,
+)
+
+BLOCK_PATH = os.path.join(
+    REFERENCE_ROOT, "depend", "bitcoin", "src", "bench", "data", "block413567.raw"
+)
+
+pytestmark = pytest.mark.skipif(
+    not os.path.exists(BLOCK_PATH), reason="mainnet block fixture not found"
+)
+
+
+def _load():
+    with open(BLOCK_PATH, "rb") as f:
+        return f.read()
+
+
+def test_block413567_roundtrip_and_rules():
+    raw = _load()
+    block = Block.deserialize(raw)
+    # Wire codec round-trips the full 999,887 bytes bit-exactly.
+    assert block.serialize() == raw
+    # Known shape of mainnet block 413567 (checkblock.cpp's fixture).
+    assert len(block.vtx) == 1557
+    assert block.vtx[0].is_coinbase()
+    # Pre-segwit block: no witness data anywhere.
+    assert not any(tx.has_witness() for tx in block.vtx)
+    # Header commitments hold: merkle root and proof of work.
+    assert block_merkle_root(block)[0] == block.header.merkle_root
+    assert check_proof_of_work(block.header.hash, block.header.bits)
+    # Full context-free CheckBlock passes.
+    ok, reason = check_block(block)
+    assert ok, reason
+
+
+def test_block413567_txids_consistent():
+    raw = _load()
+    block = Block.deserialize(raw)
+    # txid == wtxid for every tx (no witness), all unique.
+    ids = {tx.txid for tx in block.vtx}
+    assert len(ids) == len(block.vtx)
+    for tx in block.vtx[:50]:
+        assert tx.txid == tx.wtxid
