@@ -182,12 +182,17 @@ def _prepare(
         else:
             try:
                 ntx = native_bridge.NativeTx(item.spending_tx)
-                if item.spent_outputs is not None:
-                    ntx.set_spent_outputs(list(item.spent_outputs))
-                else:
-                    ntx.precompute()
             except ValueError:
                 ntx = None
+            if ntx is not None:
+                # Precompute only with a LENGTH-VALID prevout list (one per
+                # input); a mismatched list is rejected below with
+                # ERR_TX_INDEX and the handle stays un-precomputed (it is
+                # never interpreted — same key means same mismatch).
+                if item.spent_outputs is None:
+                    ntx.precompute()
+                elif len(spent_outputs) == ntx.n_inputs:
+                    ntx.set_spent_outputs(list(item.spent_outputs))
             ntx_cache[key] = ntx
         if ntx is None:
             prep.result = BatchResult(False, Error.ERR_TX_DESERIALIZE)

@@ -129,17 +129,12 @@ def _inf_like(X):
     return ones, ones, zeros
 
 
-def jacobian_madd_complete(X1, Y1, Z1, x2, y2, inf1=None):
-    """Complete mixed addition (X1,Y1,Z1) + (x2,y2), (x2,y2) affine and
-    never infinity. Branchless handling of every exceptional case; the
-    generic path is madd-2007-bl (the math of `secp256k1_gej_add_ge_var`,
-    vectorized and de-branched).
-
-    `inf1`: caller-known infinity status of the left operand — None
-    computes the Z1 ≡ 0 field test (legacy), False asserts the operand is
-    finite on every live lane, a mask uses it directly. Loop callers that
-    track infinity explicitly skip one of the three exact-zero chains.
-    """
+def _madd_core(X1, Y1, Z1, x2, y2, inf1):
+    """Generic madd-2007-bl formula + exceptional-case masks (the shared
+    math of the complete and flagged mixed-add variants; one source so the
+    two kernels cannot diverge). Returns (generic_triple, h_zero, r_zero,
+    z1_zero) where z1_zero follows the inf1 convention (None -> computed,
+    False -> statically finite, mask -> as given)."""
     Z1Z1 = fe_sqr(Z1)
     U2 = fe_mul(x2, Z1Z1)
     S2 = fe_mul(y2, fe_mul(Z1, Z1Z1))
@@ -159,30 +154,45 @@ def jacobian_madd_complete(X1, Y1, Z1, x2, y2, inf1=None):
     X3 = fe_sub(fe_sqr(r), fe_add(J, fe_mul_small(V, 2)))
     Y3 = fe_sub(fe_mul(r, fe_sub(V, X3)), fe_mul_small(fe_mul(Y1, J), 2))
     Z3 = fe_sub(fe_sqr(fe_add(Z1, H)), fe_add(Z1Z1, HH))
-    out = (X3, Y3, Z3)
+    return (X3, Y3, Z3), h_zero, r_zero, z1_zero
 
+
+def _madd_lift(out, X1, x2, y2, z1_zero):
+    """Infinite-left-operand case: result is the lifted affine operand."""
+    ones = jnp.broadcast_to(_col(_ONE, X1), X1.shape).astype(X1.dtype)
+    lift = (jnp.broadcast_to(x2, X1.shape).astype(X1.dtype),
+            jnp.broadcast_to(y2, X1.shape).astype(X1.dtype), ones)
+    return _select(z1_zero, lift, out)
+
+
+def jacobian_madd_complete(X1, Y1, Z1, x2, y2, inf1=None):
+    """Complete mixed addition (X1,Y1,Z1) + (x2,y2), (x2,y2) affine and
+    never infinity. Branchless handling of every exceptional case; the
+    generic path is madd-2007-bl (the math of `secp256k1_gej_add_ge_var`,
+    vectorized and de-branched).
+
+    `inf1`: caller-known infinity status of the left operand — None
+    computes the Z1 ≡ 0 field test (legacy), False asserts the operand is
+    finite on every live lane, a mask uses it directly. Loop callers that
+    track infinity explicitly skip one of the three exact-zero chains.
+    """
+    out, h_zero, r_zero, z1_zero = _madd_core(X1, Y1, Z1, x2, y2, inf1)
     dbl = jacobian_double(X1, Y1, Z1)
     out = _select(h_zero & r_zero, dbl, out)
     out = _select(h_zero & ~r_zero, _inf_like(X1), out)
     if z1_zero is False:
         # Known-finite left operand: result is infinite only via P+(-P).
         return out + (h_zero & ~r_zero,)
-    ones = jnp.broadcast_to(_col(_ONE, X1), X1.shape).astype(X1.dtype)
-    lift = (jnp.broadcast_to(x2, X1.shape).astype(X1.dtype),
-            jnp.broadcast_to(y2, X1.shape).astype(X1.dtype), ones)
-    out = _select(z1_zero, lift, out)
+    out = _madd_lift(out, X1, x2, y2, z1_zero)
     if inf1 is None:
         return out
     # inf1 given: also report the result's infinity (affine op is finite).
     return out + (~z1_zero & h_zero & ~r_zero,)
 
 
-def jacobian_add_complete(X1, Y1, Z1, X2, Y2, Z2, inf2, inf1=None):
-    """Complete Jacobian+Jacobian addition (add-2007-bl), branchless.
-
-    `inf2` is the caller-known infinity mask for the second operand (table
-    entry 0), avoiding a field-level zero test on Z2. `inf1` (optional)
-    does the same for the first operand — None computes the Z1 ≡ 0 test."""
+def _add_core(X1, Y1, Z1, X2, Y2, Z2, inf1):
+    """Generic add-2007-bl formula + exceptional-case masks (shared by the
+    complete and flagged Jacobian-add variants)."""
     Z1Z1 = fe_sqr(Z1)
     Z2Z2 = fe_sqr(Z2)
     U1 = fe_mul(X1, Z2Z2)
@@ -206,8 +216,16 @@ def jacobian_add_complete(X1, Y1, Z1, X2, Y2, Z2, inf2, inf1=None):
     Z3 = fe_mul(
         fe_sub(fe_sqr(fe_add(Z1, Z2)), fe_add(Z1Z1, Z2Z2)), H
     )
-    out = (X3, Y3, Z3)
+    return (X3, Y3, Z3), h_zero, r_zero, z1_zero
 
+
+def jacobian_add_complete(X1, Y1, Z1, X2, Y2, Z2, inf2, inf1=None):
+    """Complete Jacobian+Jacobian addition (add-2007-bl), branchless.
+
+    `inf2` is the caller-known infinity mask for the second operand (table
+    entry 0), avoiding a field-level zero test on Z2. `inf1` (optional)
+    does the same for the first operand — None computes the Z1 ≡ 0 test."""
+    out, h_zero, r_zero, z1_zero = _add_core(X1, Y1, Z1, X2, Y2, Z2, inf1)
     dbl = jacobian_double(X1, Y1, Z1)
     out = _select(h_zero & r_zero, dbl, out)
     out = _select(h_zero & ~r_zero, _inf_like(X1), out)
@@ -218,6 +236,41 @@ def jacobian_add_complete(X1, Y1, Z1, X2, Y2, Z2, inf2, inf1=None):
     # Result infinity: both operands infinite, or finite cancellation.
     out_inf = (z1_zero & inf2) | (~z1_zero & ~inf2 & h_zero & ~r_zero)
     return out + (out_inf,)
+
+
+def jacobian_madd_flagged(X1, Y1, Z1, x2, y2, inf1):
+    """Mixed addition WITHOUT the embedded doubling fallback: the
+    equal-points case (h ≡ 0, r ≡ 0) is only FLAGGED (`needs_dbl`), not
+    computed — callers defer flagged lanes to the exact host path. Saves
+    the jacobian_double (+selects) that `jacobian_madd_complete` pays on
+    every call for a case honest traffic never hits (R == ±table point
+    requires a crafted scalar collision). Same `_madd_core` math as the
+    complete variant. `inf1` is the caller-tracked infinity mask of the
+    left operand (or False when statically finite). Returns
+    (X, Y, Z, out_inf, needs_dbl)."""
+    out, h_zero, r_zero, z1_zero = _madd_core(X1, Y1, Z1, x2, y2, inf1)
+    out = _select(h_zero & ~r_zero, _inf_like(X1), out)
+    if z1_zero is False:
+        # Caller-asserted finite left operand: no lift select needed.
+        return out + (h_zero & ~r_zero, h_zero & r_zero)
+    out = _madd_lift(out, X1, x2, y2, z1_zero)
+    out_inf = ~z1_zero & h_zero & ~r_zero
+    needs_dbl = ~z1_zero & h_zero & r_zero
+    return out + (out_inf, needs_dbl)
+
+
+def jacobian_add_flagged(X1, Y1, Z1, X2, Y2, Z2, inf2, inf1):
+    """Jacobian+Jacobian addition without the doubling fallback (see
+    jacobian_madd_flagged); same `_add_core` math as the complete variant.
+    `inf2`/`inf1`: caller-tracked infinity masks. Returns
+    (X, Y, Z, out_inf, needs_dbl)."""
+    out, h_zero, r_zero, z1_zero = _add_core(X1, Y1, Z1, X2, Y2, Z2, inf1)
+    out = _select(h_zero & ~r_zero, _inf_like(X1), out)
+    out = _select(z1_zero, (X2, Y2, Z2), out)
+    out = _select(inf2, (X1, Y1, Z1), out)
+    out_inf = (z1_zero & inf2) | (~z1_zero & ~inf2 & h_zero & ~r_zero)
+    needs_dbl = ~z1_zero & ~inf2 & h_zero & r_zero
+    return out + (out_inf, needs_dbl)
 
 
 def scalar_bits(limbs):

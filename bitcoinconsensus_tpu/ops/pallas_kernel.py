@@ -50,9 +50,9 @@ from .curve import (
     _g_table,
     _inf_like,
     _select,
-    jacobian_add_complete,
+    jacobian_add_flagged,
     jacobian_double,
-    jacobian_madd_complete,
+    jacobian_madd_flagged,
 )
 from .curve import _BETA_LIMBS, _GX_LIMBS, _GY_LIMBS, _ONE, _digits128
 from .limbs import (
@@ -256,17 +256,22 @@ def _kernel_body(
     # (fori_loop + dynamic scratch store; Mosaic cannot lower a scan with
     # per-step stacked outputs.) Row r holds (r+1)·P — signed digits never
     # select zero (handled by the add's zero-mask), so no infinity row.
+    # Row 1 (2P) is an explicit doubling; the remaining rows use the
+    # FLAGGED mixed add (no embedded doubling fallback — kP == ±P is
+    # impossible for 2 <= k <= 15, the flag is folded defensively).
     ones = _const_col(_ONE, px)
     tx_ref[0], ty_ref[0], tz_ref[0] = px, py, ones
+    p2 = jacobian_double(px, py, ones)
+    tx_ref[1], ty_ref[1], tz_ref[1] = p2
+    zero_i = jnp.zeros(px.shape[1:], dtype=jnp.int32)
 
     def tstep(k, carry):
-        # carry = k·P, never infinity for on-curve P (inf1=False).
-        *nxt, _cancel = jacobian_madd_complete(*carry, px, py, inf1=False)
-        nxt = tuple(nxt)
-        tx_ref[k], ty_ref[k], tz_ref[k] = nxt
-        return nxt
+        X, Y, Z, nh = carry
+        X, Y, Z, _inf, ndbl = jacobian_madd_flagged(X, Y, Z, px, py, inf1=False)
+        tx_ref[k], ty_ref[k], tz_ref[k] = X, Y, Z
+        return X, Y, Z, nh | ndbl.astype(jnp.int32)
 
-    lax.fori_loop(1, 16, tstep, (px, py, ones))
+    *_tbl, needs32 = lax.fori_loop(2, 16, tstep, p2 + (zero_i,))
     TX, TY, TZ = tx_ref[:], ty_ref[:], tz_ref[:]
 
     # -- (±b1 ± lambda·b2)·P: 26 signed 5-bit windows of 5 doublings + 2
@@ -277,10 +282,10 @@ def _kernel_body(
         _const_col(_BETA_LIMBS, px)[:, :1], px.shape
     ).astype(px.dtype)
 
-    # Infinity masks ride the fori_loop carries as int32 0/1 — Mosaic
-    # cannot lower i1 vectors through loop boundaries.
+    # Infinity and needs-host masks ride the fori_loop carries as int32
+    # 0/1 — Mosaic cannot lower i1 vectors through loop boundaries.
     def wbody(i, carry):
-        X, Y, Z, r_inf32 = carry
+        X, Y, Z, r_inf32, nh = carry
         r_inf = r_inf32 == 1
         R = (X, Y, Z)
         w = SGLV_WINDOWS - 1 - i
@@ -296,7 +301,7 @@ def _kernel_body(
         sely = jnp.sum(TY * oh, axis=0)
         selz = jnp.sum(TZ * oh, axis=0)
         sely = jnp.where(s1 == 1, fe_sub(jnp.zeros_like(sely), sely), sely)
-        *R, r_inf = jacobian_add_complete(
+        *R, r_inf, nd1 = jacobian_add_flagged(
             *R, selx, sely, selz, d1 == 0, inf1=r_inf
         )
         d2 = db2_ref[w]
@@ -306,14 +311,15 @@ def _kernel_body(
         sely = jnp.sum(TY * oh, axis=0)
         selz = jnp.sum(TZ * oh, axis=0)
         sely = jnp.where(s2 == 1, fe_sub(jnp.zeros_like(sely), sely), sely)
-        X, Y, Z, r_inf = jacobian_add_complete(
+        X, Y, Z, r_inf, nd2 = jacobian_add_flagged(
             *R, selx, sely, selz, d2 == 0, inf1=r_inf
         )
-        return X, Y, Z, r_inf.astype(jnp.int32)
+        nh = nh | nd1.astype(jnp.int32) | nd2.astype(jnp.int32)
+        return X, Y, Z, r_inf.astype(jnp.int32), nh
 
     all_inf = jnp.ones(px.shape[1:], dtype=jnp.int32)
-    X, Y, Z, r_inf32 = lax.fori_loop(
-        0, SGLV_WINDOWS, wbody, _inf_like(px) + (all_inf,)
+    X, Y, Z, r_inf32, needs32 = lax.fori_loop(
+        0, SGLV_WINDOWS, wbody, _inf_like(px) + (all_inf, needs32)
     )
     r_inf = r_inf32 == 1
     R = (X, Y, Z)
@@ -323,7 +329,7 @@ def _kernel_body(
     k255 = jax.lax.broadcasted_iota(jnp.int32, (255, 1), 0) + 1
 
     def gbody(i, carry):
-        Xg, Yg, Zg, rg_inf32 = carry
+        Xg, Yg, Zg, rg_inf32, nh = carry
         rg_inf = rg_inf32 == 1
         da = da_ref[i]  # ref-indexed dynamic VMEM load, (tile,)
         oh = (da[None, :] == k255).astype(jnp.float32)  # (255, T)
@@ -339,23 +345,32 @@ def _kernel_body(
             preferred_element_type=jnp.float32,
             precision=lax.Precision.HIGHEST,
         ).astype(jnp.int32)
-        Xa, Ya, Za, inf_a = jacobian_madd_complete(
+        Xa, Ya, Za, inf_a, nd = jacobian_madd_flagged(
             Xg, Yg, Zg, selx, sely, inf1=rg_inf
         )
         app = da > 0
         out = _select(app, (Xa, Ya, Za), (Xg, Yg, Zg))
         # int32 branch values: Mosaic cannot lower selects over i1 vectors.
-        return out + (jnp.where(app, inf_a.astype(jnp.int32), rg_inf32),)
+        return out + (
+            jnp.where(app, inf_a.astype(jnp.int32), rg_inf32),
+            nh | jnp.where(app, nd.astype(jnp.int32), 0),
+        )
 
-    Xg, Yg, Zg, rg_inf32 = lax.fori_loop(
-        0, G_WINDOWS, gbody, _inf_like(px) + (all_inf,)
+    Xg, Yg, Zg, rg_inf32, needs32 = lax.fori_loop(
+        0, G_WINDOWS, gbody, _inf_like(px) + (all_inf, needs32)
     )
-    X, Y, Z, inf_mask = jacobian_add_complete(
+    X, Y, Z, inf_mask, nd_join = jacobian_add_flagged(
         *R, Xg, Yg, Zg, rg_inf32 == 1, inf1=r_inf
     )
+    needs = (needs32 | nd_join.astype(jnp.int32)) == 1
+    needs = needs & valid  # invalid lanes never defer (sanitized to G)
 
     # -- affine + accept -------------------------------------------------
-    zi = _tile_batch_inv(Z, inf_mask, ones)
+    # Deferred lanes carry garbage (often Z ≡ 0 from the skipped doubling
+    # case) — they must contribute 1 to the cross-lane inversion product
+    # exactly like infinity lanes, or they would zero EVERY lane's affine
+    # coordinates (pinned by test_exceptional_case_deferred_to_host).
+    zi = _tile_batch_inv(Z, inf_mask | needs, ones)
     zi2 = fe_sqr(zi)
     x = fe_canon(fe_mul(X, zi2))
     y = fe_canon(fe_mul(Y, fe_mul(zi2, zi)))
@@ -365,8 +380,9 @@ def _kernel_body(
     )
     y_odd = (y[0] & 1) == 1
     par_ok = (parity_req < 0) | (y_odd == (parity_req == 1))
-    ok = valid & ~inf_mask & ok_x & par_ok
+    ok = valid & ~inf_mask & ok_x & par_ok & ~needs
     ok_ref[0, :] = ok.astype(jnp.int32)
+    ok_ref[1, :] = needs.astype(jnp.int32)
 
 
 @functools.partial(jax.jit, static_argnames=("tile", "interpret"))
@@ -374,11 +390,15 @@ def verify_tiles(
     fields, want_odd, parity_req, has_t2, neg1, neg2, valid,
     tile=LANE_TILE, interpret=False,
 ):
-    """Drop-in replacement for `jax_backend._verify_kernel` running the
-    heavy math as a Pallas grid over lane tiles.
+    """Replacement for `jax_backend._verify_kernel` running the heavy math
+    as a Pallas grid over lane tiles.
 
     fields: (B, 4, 32) uint8 LE (a, |b1|‖|b2|, px, t1); flag vectors (B,)
-    int32 / bool. B must be a multiple of `tile`. Returns (B,) bool.
+    int32 / bool. B must be a multiple of `tile`. Returns
+    ``(ok, needs_host)`` — both (B,) bool. ``needs_host`` marks lanes that
+    hit an exceptional group-law case the fast adds defer (crafted scalar
+    collisions only; such lanes report ok=False and MUST be re-checked by
+    the exact host path, which TpuSecpVerifier.verify_checks does).
     """
     B = fields.shape[0]
     assert B % tile == 0, (B, tile)
@@ -440,8 +460,8 @@ def verify_tiles(
             shared(gx.shape),  # G window table x
             shared(gy.shape),  # G window table y
         ],
-        out_specs=pl.BlockSpec((1, tile), lambda i: (0, i), memory_space=pltpu.VMEM),
-        out_shape=jax.ShapeDtypeStruct((1, B), jnp.int32),
+        out_specs=pl.BlockSpec((2, tile), lambda i: (0, i), memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((2, B), jnp.int32),
         scratch_shapes=[
             pltpu.VMEM((16, NLIMB, tile), jnp.int32),  # P-table x
             pltpu.VMEM((16, NLIMB, tile), jnp.int32),  # P-table y
@@ -449,4 +469,4 @@ def verify_tiles(
         ],
         interpret=interpret,
     )(px, t1, t1n, da, db1, ds1, db2, ds2, flags, consts, gx, gy)
-    return ok[0] != 0
+    return ok[0] != 0, ok[1] != 0

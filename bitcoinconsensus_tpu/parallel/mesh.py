@@ -73,9 +73,10 @@ def _pick_backend(use_pallas: bool):
                 return verify_tiles(
                     fields, want_odd, parity_req, has_t2, neg1, neg2, valid
                 )
-        return _verify_kernel(
+        ok = _verify_kernel(
             fields, want_odd, parity_req, has_t2, neg1, neg2, valid
         )
+        return ok, jnp.zeros_like(ok)  # complete-add kernel: no deferrals
 
     return local_kernel
 
@@ -84,14 +85,17 @@ def make_sharded_step(mesh: Mesh, use_pallas: Optional[bool] = None):
     """The full multichip verify step, jitted over `mesh`.
 
     Returns ``step(fields, want_odd, parity_req, has_t2, neg1, neg2,
-    valid, live) -> (per_lane, all_ok)`` where inputs are batch-sharded,
-    `per_lane` comes back batch-sharded, and `all_ok` is a replicated
-    scalar produced by a psum AND-reduction inside shard_map (the
-    cross-chip collective — the `CCheckQueueControl::Wait` analogue,
-    checkqueue.h:139-142). `live` marks real lanes: padding added to reach
-    the batch shape is not counted as a failure, while structurally-invalid
-    real lanes are. Each shard runs the production backend selection
-    (Pallas on TPU when the local tile divides; XLA otherwise).
+    valid, live) -> (per_lane, needs_host, all_ok)`` where inputs are
+    batch-sharded, `per_lane`/`needs_host` come back batch-sharded, and
+    `all_ok` is a replicated scalar produced by a psum AND-reduction inside
+    shard_map (the cross-chip collective — the `CCheckQueueControl::Wait`
+    analogue, checkqueue.h:139-142). `live` marks real lanes: padding added
+    to reach the batch shape is not counted as a failure, while
+    structurally-invalid real lanes are. `needs_host` lanes (exceptional
+    group-law deferrals of the pallas fast adds) are excluded from the
+    device verdict — the host resolves them exactly and adjusts. Each shard
+    runs the production backend selection (Pallas on TPU when the local
+    tile divides; XLA otherwise).
     """
     axis = mesh.axis_names[0]
     fields_sharding = NamedSharding(mesh, P(axis, None, None))
@@ -102,12 +106,13 @@ def make_sharded_step(mesh: Mesh, use_pallas: Optional[bool] = None):
     local_kernel = _pick_backend(use_pallas)
 
     def local_step(fields, want_odd, parity_req, has_t2, neg1, neg2, valid, live):
-        per_lane = local_kernel(
+        per_lane, needs = local_kernel(
             fields, want_odd, parity_req, has_t2, neg1, neg2, valid
         )
-        # all-valid <=> no live lane failed, on any shard.
-        failures = jnp.sum(jnp.where(live & ~per_lane, 1, 0))
-        return per_lane, jax.lax.psum(failures, axis) == 0
+        # all-valid <=> no live lane DEFINITELY failed, on any shard
+        # (deferred lanes stay out; the host fixup ANDs their verdicts in).
+        failures = jnp.sum(jnp.where(live & ~per_lane & ~needs, 1, 0))
+        return per_lane, needs, jax.lax.psum(failures, axis) == 0
 
     # Varying-axes checking is off: the verify kernel's scan carries start
     # as mesh-wide constants (infinity masks, G-table selects) and become
@@ -117,13 +122,13 @@ def make_sharded_step(mesh: Mesh, use_pallas: Optional[bool] = None):
         local_step,
         mesh=mesh,
         in_specs=(P(axis, None, None),) + (P(axis),) * 7,
-        out_specs=(P(axis), P()),
+        out_specs=(P(axis), P(axis), P()),
         **_SHARD_MAP_KW,
     )
     return jax.jit(
         sharded,
         in_shardings=(fields_sharding,) + (flat_sharding,) * 7,
-        out_shardings=(flat_sharding, replicated),
+        out_shardings=(flat_sharding, flat_sharding, replicated),
     )
 
 
@@ -145,13 +150,13 @@ class ShardedSecpVerifier(TpuSecpVerifier):
         self._verdict_acc = True
         self._dispatched = 0
 
-    def _run_kernel(self, args, n: int) -> np.ndarray:
+    def _run_kernel(self, args, n: int):
         live = np.zeros(args[-1].shape[0], dtype=bool)
         live[:n] = True
-        per_lane, all_ok = self._step(*args, live)
+        per_lane, needs, all_ok = self._step(*args, live)
         self._verdict_acc = self._verdict_acc and bool(all_ok)
         self._dispatched += n
-        return per_lane
+        return per_lane, needs
 
     def verify_checks_with_verdict(self, checks: Sequence[SigCheck]):
         """(per-check results, block-level all-ok).
@@ -160,10 +165,16 @@ class ShardedSecpVerifier(TpuSecpVerifier):
         AND-reduction inside the sharded step (the collective barrier), not
         a host re-reduction; lanes rejected host-side before dispatch
         (structural parse failures) AND into the verdict via the dispatched
-        count.
+        count, and host-resolved exceptional deferrals AND in via
+        `_fixup_failed`.
         """
         self._verdict_acc = True
         self._dispatched = 0
+        self._fixup_failed = False
         res = self.verify_checks(checks)
-        verdict = self._verdict_acc and self._dispatched == len(checks)
+        verdict = (
+            self._verdict_acc
+            and self._dispatched == len(checks)
+            and not self._fixup_failed
+        )
         return res, verdict

@@ -712,7 +712,10 @@ inline void precompute(NTx& tx, const std::vector<NTxOut>* spent) {
     Precomp& pc = tx.precomp;
     pc = Precomp();
     pc.ready = true;
-    if (spent) {
+    // A prevout list is only usable when it has exactly one entry per
+    // input (interpreter.cpp:1512 readiness contract); a wrong-length
+    // list is ignored rather than indexed out of bounds.
+    if (spent && spent->size() == tx.vin.size()) {
         pc.spent_outputs = *spent;
         pc.spent_ready = true;
     }

@@ -233,6 +233,17 @@ def test_batch_transport_error_order_matches_single():
     assert got[4].ok
 
 
+def test_batch_wrong_length_prevout_list():
+    """A spent_outputs list that doesn't match the input count must be a
+    clean ERR_TX_INDEX (never an OOB read in the native precompute)."""
+    txb, spk, amt = make_p2wpkh_spend("prevlen")
+    for outs in ([], [(amt, spk), (amt, spk)]):
+        res = verify_batch(
+            [BatchItem(txb, 0, VERIFY_ALL_LIBCONSENSUS, spent_outputs=outs)]
+        )
+        assert res[0].error == Error.ERR_TX_INDEX, (len(outs), res[0])
+
+
 def test_taproot_single_api_roundtrip():
     txb, spk, amt = make_p2tr_keypath_spend("roundtrip")
     api.verify_with_spent_outputs(txb, 0, [(amt, spk)])

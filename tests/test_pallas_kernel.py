@@ -44,12 +44,12 @@ def test_pallas_matches_xla_kernel():
     want = np.asarray(
         _verify_kernel(fields, want_odd, parity, has_t2, neg1, neg2, valid)
     )
-    got = np.asarray(
-        verify_tiles(
-            fields, want_odd, parity, has_t2, neg1, neg2, valid,
-            tile=8, interpret=True,
-        )
+    got_ok, got_needs = verify_tiles(
+        fields, want_odd, parity, has_t2, neg1, neg2, valid,
+        tile=8, interpret=True,
     )
+    got = np.asarray(got_ok)
+    assert not np.asarray(got_needs).any()  # no group-law deferrals here
     assert (got == want).all(), (got, want)
     assert not want[3] and not want[5] and not want[2] and not want[4]
     assert want[0] and want[1]
@@ -95,15 +95,74 @@ def test_pallas_production_shape_matches_xla():
     want = np.asarray(
         _verify_kernel(fields, want_odd, parity, has_t2, neg1, neg2, valid)
     )
-    got = np.asarray(
-        verify_tiles(
-            fields, want_odd, parity, has_t2, neg1, neg2, valid,
-            tile=LANE_TILE, interpret=True,
-        )
+    got_ok, got_needs = verify_tiles(
+        fields, want_odd, parity, has_t2, neg1, neg2, valid,
+        tile=LANE_TILE, interpret=True,
     )
+    got = np.asarray(got_ok)
+    assert not np.asarray(got_needs).any()
     assert (got == want).all(), np.nonzero(got != want)
     bad = [0, 1, 2, 3, 4, 6, 9, 10, 12]
     assert not want[bad].any(), want[bad]
     mask = np.ones(LANE_TILE, dtype=bool)
     mask[bad] = False
     assert want[mask].all(), np.nonzero(~want & mask)
+
+
+def _collision_tweak_check():
+    """A VALID taproot-tweak check crafted to hit the equal-points case:
+    internal = G (x-only), t = 1 -> Q = 1·G + 1·G, so the kernel's final
+    join adds G to G — the exact group-law case the fast adds defer."""
+    from bitcoinconsensus_tpu.crypto import secp_host as H
+    from bitcoinconsensus_tpu.crypto.jax_backend import SigCheck
+
+    qx, qy = H.G.mul(2).to_affine()
+    return SigCheck(
+        "tweak",
+        (
+            qx.to_bytes(32, "big"),
+            qy & 1,
+            H.G_X.to_bytes(32, "big"),
+            (1).to_bytes(32, "big"),
+        ),
+    )
+
+
+def test_exceptional_case_deferred_to_host():
+    """The pallas fast adds flag crafted scalar collisions as needs_host
+    (ok=False on device); the XLA complete kernel resolves them directly;
+    verify_checks' host fixup restores the exact verdict."""
+    import __graft_entry__ as ge
+    from bitcoinconsensus_tpu.crypto.jax_backend import TpuSecpVerifier, _verify_kernel
+    from bitcoinconsensus_tpu.ops.pallas_kernel import verify_tiles
+
+    checks = ge._example_checks(7)
+    checks[0] = _collision_tweak_check()
+    v = TpuSecpVerifier(min_batch=8)
+    args = v._pack_lanes(v._prep_lanes(checks))
+
+    want = np.asarray(_verify_kernel(*args))
+    assert want[:7].all()  # XLA complete kernel: collision resolves TRUE
+
+    ok, needs = verify_tiles(*args, tile=8, interpret=True)
+    ok, needs = np.asarray(ok), np.asarray(needs)
+    assert needs[0] and not ok[0], "collision lane must defer"
+    assert not needs[1:7].any() and ok[1:7].all(), "others unaffected"
+
+    # Full fixup loop through verify_checks (device part simulated: the
+    # CPU test env runs the XLA kernel, so inject the pallas-shaped
+    # (ok, needs) result).
+    orig = v._run_kernel
+
+    def pallas_shaped(args, n):
+        res = np.asarray(orig(args, n))
+        needs = np.zeros(res.shape[0], dtype=bool)
+        needs[0] = True
+        res = res.copy()
+        res[0] = False
+        return res, needs
+
+    v._run_kernel = pallas_shaped
+    out = v.verify_checks(checks)
+    assert out.all(), "host fixup must resolve the deferred lane TRUE"
+    assert not v._fixup_failed
