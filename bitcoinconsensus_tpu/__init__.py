@@ -28,17 +28,22 @@ from .api import (
 )
 from .core import flags
 from .core.script_error import ScriptError
+from .crypto.bip32 import ExtPubKey, pubkey_derive
+from .crypto.recovery import recover_compact
 
 __version__ = "0.1.0"
 
 __all__ = [
     "ConsensusError",
     "Error",
+    "ExtPubKey",
     "ScriptError",
     "VERIFY_ALL_EXTENDED",
     "VERIFY_ALL_LIBCONSENSUS",
     "flags",
     "height_to_flags",
+    "pubkey_derive",
+    "recover_compact",
     "verify",
     "verify_with_flags",
     "verify_with_spent_outputs",

@@ -111,7 +111,9 @@ def _ser_witness_stack(stack: List[bytes]) -> bytes:
 class Tx:
     """Immutable transaction with cached txid/wtxid (transaction.h:259-350)."""
 
-    __slots__ = ("version", "vin", "vout", "locktime", "_txid", "_wtxid")
+    __slots__ = (
+        "version", "vin", "vout", "locktime", "_txid", "_wtxid", "_ser",
+    )
 
     def __init__(self, version: int, vin: List[TxIn], vout: List[TxOut], locktime: int):
         self.version = version  # signed int32 semantics
@@ -120,6 +122,7 @@ class Tx:
         self.locktime = locktime
         self._txid: Optional[bytes] = None
         self._wtxid: Optional[bytes] = None
+        self._ser: dict = {}  # include_witness -> cached wire bytes
 
     # -- codec --------------------------------------------------------------
     @classmethod
@@ -165,8 +168,13 @@ class Tx:
         return any(txin.witness for txin in self.vin)
 
     def serialize(self, include_witness: bool = True) -> bytes:
-        """Exact mirror of SerializeTransaction (transaction.h:227-253)."""
+        """Exact mirror of SerializeTransaction (transaction.h:227-253).
+        Memoized like txid/wtxid (the class is immutable by contract; a
+        block replay serializes every tx for weight, ids AND batch items)."""
         use_witness = include_witness and self.has_witness()
+        cached = self._ser.get(use_witness)
+        if cached is not None:
+            return cached
         parts = [struct.pack("<i", self.version)]
         if use_witness:
             parts.append(write_compact_size(0) + b"\x01")
@@ -180,7 +188,9 @@ class Tx:
             for txin in self.vin:
                 parts.append(_ser_witness_stack(txin.witness))
         parts.append(struct.pack("<I", self.locktime))
-        return b"".join(parts)
+        out = b"".join(parts)
+        self._ser[use_witness] = out
+        return out
 
     # -- identity -----------------------------------------------------------
     @property

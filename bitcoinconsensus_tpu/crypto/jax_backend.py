@@ -324,10 +324,29 @@ class TpuSecpVerifier:
     =0/1 forces it off/on.
     """
 
-    def __init__(self, min_batch: int = 8, chunk: int = 1 << 13):
+    def __init__(
+        self,
+        min_batch: int = 8,
+        chunk: int = 1 << 13,
+        pad_step: Optional[int] = None,
+    ):
+        """`pad_step`: cap the power-of-two pad ladder at the next multiple
+        of this step (small batches still pad to the ladder). Every distinct
+        padded shape compiles once (15-60 s for the pallas kernel), so a
+        small step only pays off for a recurring batch size — e.g. a
+        block-replay driver padding ~5.6k checks to 6144 (step 2048)
+        instead of 8192 saves ~25% device time after the one-time compile.
+        Must be a multiple of the 512-lane pallas tile (and min_batch a
+        power of two times 512) or TPU dispatches silently fall back to the
+        slower XLA kernel."""
+        if pad_step is not None and (pad_step <= 0 or pad_step % 512 != 0):
+            raise ValueError(
+                "pad_step must be a positive multiple of the 512-lane tile"
+            )
         self._kernel = jax.jit(_verify_kernel)
         self._min_batch = min_batch
         self._chunk = chunk
+        self._pad_step = pad_step
         env = os.environ.get("BITCOINCONSENSUS_TPU_PALLAS", "")
         if env in ("0", "off"):
             self._use_pallas = False
@@ -353,6 +372,12 @@ class TpuSecpVerifier:
         size = self._min_batch
         while size < n:
             size *= 2
+        if self._pad_step is not None:
+            # Whichever is smaller: the power-of-two ladder or the step
+            # rounding — a 5.6k main dispatch pads to 6144 (not 8192) while
+            # a 4-check oracle round still pads to min_batch, not a full step.
+            step = self._pad_step
+            return min(size, max(self._min_batch, ((n + step - 1) // step) * step))
         return size
 
     def _prep_lanes(self, checks: Sequence[SigCheck]) -> List["_Lane"]:

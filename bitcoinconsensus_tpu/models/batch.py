@@ -308,7 +308,9 @@ def verify_batch(
     # Script-execution cache probe: a hit certifies this exact
     # (wtxid, input, flags, prevouts) succeeded before — skip the
     # interpreter and the device outright (validation.cpp:1529-1536).
-    spent_digests: List[Optional[bytes]] = [None] * len(items)
+    script_keys: List[Optional[bytes]] = [None] * len(items)
+    probe_idx: List[int] = []
+    probe_parts: List[Tuple[bytes, ...]] = []
     for idx, (item, prep) in enumerate(zip(items, preps)):
         if prep.result is not None or prep.wtxid is None:
             continue
@@ -318,23 +320,22 @@ def verify_batch(
             digest = ScriptExecutionCache.spent_digest(
                 [(item.amount, item.spent_output_script or b"")]
             )
-        spent_digests[idx] = digest
-        if script_cache.contains_input(
-            prep.wtxid, item.input_index, item.flags, digest
-        ):
-            prep.result = BatchResult.success()
-
-    # Phase 1: optimistic interpretation, recording curve checks. The
-    # native engine (native/eval.hpp, deferring mode) runs the same
-    # protocol at C++ speed; the Python engine is the fallback and spec.
-    def interpret_deferring(item, prep) -> Tuple[bool, ScriptError, int, List[SigCheck]]:
-        if prep.ntx is not None:
-            ok, err_code, unk = nsess.verify_input(
-                prep.ntx, item.input_index, prep.amount, prep.script_pubkey,
-                item.flags, mode=native_bridge.NativeSession.MODE_DEFER,
+        probe_idx.append(idx)
+        probe_parts.append(
+            ScriptExecutionCache._parts(
+                prep.wtxid, item.input_index, item.flags, digest
             )
-            checks = [SigCheck(k, d) for k, d in nsess.take_records()]
-            return ok, ScriptError(err_code), unk, checks
+        )
+    for idx, key in zip(probe_idx, script_cache.keys_for_parts(probe_parts)):
+        script_keys[idx] = key
+        if script_cache.contains_key(key):
+            preps[idx].result = BatchResult.success()
+
+    # Phase 1: optimistic interpretation, recording curve checks. Inputs
+    # the native engine parsed run in ONE batched C call (native/eval.hpp,
+    # deferring mode — same protocol at C++ speed); this Python-engine
+    # closure is the fallback for the rest and the executable spec.
+    def interpret_deferring(item, prep) -> Tuple[bool, ScriptError, int, List[SigCheck]]:
         checker = DeferringSignatureChecker(
             prep.tx, item.input_index, prep.amount, prep.txdata, known=known
         )
@@ -348,12 +349,41 @@ def verify_batch(
         return ok, err, checker.unknown, checker.recorded
 
     known: Dict[Tuple, bool] = {}
+    native_idx = [
+        idx
+        for idx, prep in enumerate(preps)
+        if prep.result is None and prep.ntx is not None
+    ]
+    if native_idx:
+        # ONE C call interprets every native-parsed input (the per-call
+        # bridge overhead dominates a block-sized batch otherwise).
+        ok_a, err_a, _unk_a, recs = nsess.verify_inputs(
+            [preps[i].ntx for i in native_idx],
+            [items[i].input_index for i in native_idx],
+            [preps[i].amount for i in native_idx],
+            [preps[i].script_pubkey for i in native_idx],
+            [items[i].flags for i in native_idx],
+            mode=native_bridge.NativeSession.MODE_DEFER,
+        )
+        for j, idx in enumerate(native_idx):
+            preps[idx].optimistic = (bool(ok_a[j]), ScriptError(int(err_a[j])))
+            preps[idx].checks = [SigCheck(k, d) for k, d in recs[j]]
     for item, prep in zip(items, preps):
-        if prep.result is not None:
+        if prep.result is not None or prep.ntx is not None:
             continue
         ok, err, _unk, checks = interpret_deferring(item, prep)
         prep.optimistic = (ok, err)
         prep.checks = checks
+
+    # Speculative CHECKMULTISIG pairings recorded by the native engine ride
+    # the same first dispatch (they are resolve-only: never part of any
+    # prep.checks, so they cannot affect an optimistic verdict) — a
+    # misaligned multisig then re-interprets against a fully-known oracle
+    # instead of paying a second device round-trip.
+    def drain_spec() -> List[SigCheck]:
+        if nsess is None:
+            return []
+        return [SigCheck(k, d) for k, d in nsess.take_spec()]
 
     # Phase 2: sig-cache probe, then one deduplicated device dispatch for
     # every remaining recorded check (sigcache.cpp:101-122 seam). Results
@@ -363,33 +393,43 @@ def verify_batch(
     def publish_known() -> None:
         if nsess is None:
             return
-        for key, val in known.items():
-            if key not in pushed:
-                nsess.add_known(key[0], key[1], val)
-                pushed.add(key)
+        fresh_entries = [
+            (key[0], key[1], val)
+            for key, val in known.items()
+            if key not in pushed
+        ]
+        if fresh_entries:
+            nsess.add_known_batch(fresh_entries)
+            pushed.update((k, d) for k, d, _ in fresh_entries)
 
     def resolve(checks: Sequence[SigCheck]) -> None:
-        """Fill `known` for every check: sig-cache probe, then ONE
-        deduplicated device dispatch; successes feed the cache."""
-        fresh: List[SigCheck] = []
+        """Fill `known` for every check: sig-cache probe (keys digested in
+        one native call), then ONE deduplicated device dispatch; successes
+        feed the cache."""
+        todo: List[SigCheck] = []
         for chk in checks:
             key = (chk.kind, chk.data)
             if key in known:
                 continue
-            if sig_cache.contains_check(chk.kind, chk.data):
-                known[key] = True
-            else:
-                known[key] = False  # placeholder until the dispatch lands
-                fresh.append(chk)
-        if fresh:
-            run_res = verifier.verify_checks(fresh)
-            for chk, r in zip(fresh, run_res):
-                known[(chk.kind, chk.data)] = bool(r)
-                if r:  # success-only insertion, like the reference
-                    sig_cache.add_check(chk.kind, chk.data)
+            known[key] = False  # placeholder until probed/dispatched
+            todo.append(chk)
+        if todo:
+            cache_keys = sig_cache.keys_for_checks(todo)
+            fresh: List[Tuple[SigCheck, bytes]] = []
+            for chk, ck in zip(todo, cache_keys):
+                if sig_cache.contains_key(ck):
+                    known[(chk.kind, chk.data)] = True
+                else:
+                    fresh.append((chk, ck))
+            if fresh:
+                run_res = verifier.verify_checks([c for c, _ in fresh])
+                for (chk, ck), r in zip(fresh, run_res):
+                    known[(chk.kind, chk.data)] = bool(r)
+                    if r:  # success-only insertion, like the reference
+                        sig_cache.add_key(ck)
         publish_known()
 
-    resolve([chk for prep in preps for chk in prep.checks])
+    resolve([chk for prep in preps for chk in prep.checks] + drain_spec())
 
     # Phase 3: accept verdicts whose guesses all held; where any guess
     # failed, RE-interpret with the device results as an oracle —
@@ -414,7 +454,25 @@ def verify_batch(
             break
         new_checks: List[SigCheck] = []
         still: List[int] = []
+        nat_pending = [i for i in pending if preps[i].ntx is not None]
+        if nat_pending:
+            ok_a, err_a, unk_a, recs = nsess.verify_inputs(
+                [preps[i].ntx for i in nat_pending],
+                [items[i].input_index for i in nat_pending],
+                [preps[i].amount for i in nat_pending],
+                [preps[i].script_pubkey for i in nat_pending],
+                [items[i].flags for i in nat_pending],
+                mode=native_bridge.NativeSession.MODE_DEFER,
+            )
+            for j, idx in enumerate(nat_pending):
+                if int(unk_a[j]) == 0:
+                    final[idx] = (bool(ok_a[j]), ScriptError(int(err_a[j])))
+                else:
+                    new_checks.extend(SigCheck(k, d) for k, d in recs[j])
+                    still.append(idx)
         for idx in pending:
+            if preps[idx].ntx is not None:
+                continue
             item, prep = items[idx], preps[idx]
             ok, err, unknown, recorded = interpret_deferring(item, prep)
             if unknown == 0:
@@ -425,7 +483,7 @@ def verify_batch(
         if not still:
             pending = []
             break
-        resolve(new_checks)
+        resolve(new_checks + drain_spec())
         pending = still
 
     for idx in pending:  # round cap hit: exact host fallback
@@ -455,10 +513,8 @@ def verify_batch(
             continue
         ok, err = final[idx]
         if ok:
-            if spent_digests[idx] is not None:
-                script_cache.add_input(
-                    prep.wtxid, item.input_index, item.flags, spent_digests[idx]
-                )
+            if script_keys[idx] is not None:
+                script_cache.add_key(script_keys[idx])
             out.append(BatchResult.success())
         else:
             out.append(BatchResult(False, Error.ERR_SCRIPT, err))

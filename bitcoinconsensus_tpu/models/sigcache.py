@@ -64,8 +64,8 @@ class _SaltedLRU:
             h.update(p)
         return h.digest()
 
-    def contains(self, parts: Iterable[bytes], erase: bool = False) -> bool:
-        k = self._key(parts)
+    def contains_key(self, k: bytes, erase: bool = False) -> bool:
+        """Probe by a precomputed digest (see SigCache.keys_for_checks)."""
         with self._lock:
             if k in self._set:
                 self.hits += 1
@@ -77,13 +77,28 @@ class _SaltedLRU:
             self.misses += 1
             return False
 
-    def add(self, parts: Iterable[bytes]) -> None:
-        k = self._key(parts)
+    def add_key(self, k: bytes) -> None:
         with self._lock:
             self._set[k] = None
             self._set.move_to_end(k)
             while len(self._set) > self._max:
                 self._set.popitem(last=False)
+
+    def contains(self, parts: Iterable[bytes], erase: bool = False) -> bool:
+        return self.contains_key(self._key(parts), erase=erase)
+
+    def add(self, parts: Iterable[bytes]) -> None:
+        self.add_key(self._key(parts))
+
+    def keys_for_parts(self, items) -> list:
+        """Digests for many part-tuples in one native call (byte-identical
+        to `_key`; Python fallback otherwise). Pair with
+        `contains_key`/`add_key` to amortize hashing over a batch."""
+        from .. import native_bridge
+
+        if native_bridge.available():
+            return native_bridge.digest_streams(self._salt, items)
+        return [self._key(parts) for parts in items]
 
     def __len__(self) -> int:
         return len(self._set)
@@ -116,6 +131,18 @@ class SigCache(_SaltedLRU):
 
     def add_check(self, kind: str, data: Tuple) -> None:
         self.add(self._parts(kind, data))
+
+    def keys_for_checks(self, checks) -> list:
+        """Digests for many SigCheck-shaped (kind, data) checks in one
+        native call (byte-identical to `_key(_parts(...))`, asserted by
+        tests/test_sigcache.py); Python fallback otherwise. Use with
+        `contains_key`/`add_key` to amortize hashing over a batch."""
+        from .. import native_bridge
+
+        pairs = [(c.kind, c.data) for c in checks]
+        if native_bridge.available():
+            return native_bridge.digest_checks(self._salt, pairs)
+        return [self._key(self._parts(k, d)) for k, d in pairs]
 
 
 class ScriptExecutionCache(_SaltedLRU):

@@ -135,6 +135,26 @@ def lib() -> Optional[ctypes.CDLL]:
             ctypes.c_int32, ctypes.c_int32, i32p, i32p,
         ]
         L.nat_verify_input.restype = ctypes.c_int32
+        # batched surfaces (one C call per phase, not per input/check)
+        L.nat_verify_inputs.argtypes = [
+            vp, ctypes.POINTER(ctypes.c_void_p), i32p, i64p, u8p, i64p, i32p,
+            ctypes.c_int32, ctypes.c_int32, i32p, i32p, i32p, i64p,
+        ]
+        L.nat_session_spec_count.argtypes = [vp]
+        L.nat_session_spec_count.restype = ctypes.c_int32
+        L.nat_session_spec_meta.argtypes = [vp, i32p, i32p, i64p]
+        L.nat_session_spec_bytes.argtypes = [vp]
+        L.nat_session_spec_bytes.restype = ctypes.c_int64
+        L.nat_session_spec_data.argtypes = [vp, u8p]
+        L.nat_session_add_known_batch.argtypes = [
+            vp, ctypes.c_int32, i32p, u8p, i64p, i32p,
+        ]
+        L.nat_digest_checks.argtypes = [
+            u8p, ctypes.c_int64, ctypes.c_int32, i32p, u8p, i64p, u8p,
+        ]
+        L.nat_digest_streams.argtypes = [
+            u8p, ctypes.c_int64, ctypes.c_int32, i64p, i64p, u8p, u8p,
+        ]
         _lib = L
         return _lib
 
@@ -219,6 +239,95 @@ def prep_pack(checks: Sequence, size: int):
 _KIND_NAME = {0: "ecdsa", 1: "schnorr", 2: "tweak"}
 
 
+def _pack_check_parts(checks: Sequence[Tuple[str, Tuple]]):
+    """Flatten (kind, data) pairs into the (kinds, blob, offs) wire shape
+    shared by add_known_batch / digest_checks: Record part order (ecdsa
+    pubkey|sig|msg, schnorr pk32|sig64|msg, tweak q32|internal32|tweak32),
+    tweak parity in kind bit 8."""
+    n = len(checks)
+    kinds = np.empty(n, dtype=np.int32)
+    offs = np.empty(3 * n + 1, dtype=np.int64)
+    parts: List[bytes] = []
+    pos = 0
+    for i, (kind, data) in enumerate(checks):
+        if kind == "tweak":
+            p0, p1, p2 = data[0], data[2], data[3]
+            kinds[i] = 2 | ((int(data[1]) & 1) << 8)
+        else:
+            p0, p1, p2 = data
+            kinds[i] = _KIND_CODE[kind]
+        offs[3 * i] = pos
+        offs[3 * i + 1] = pos + len(p0)
+        offs[3 * i + 2] = pos + len(p0) + len(p1)
+        pos += len(p0) + len(p1) + len(p2)
+        parts.append(p0)
+        parts.append(p1)
+        parts.append(p2)
+    offs[3 * n] = pos
+    blob = (
+        np.frombuffer(b"".join(parts), dtype=np.uint8)
+        if pos
+        else np.zeros(1, dtype=np.uint8)
+    )
+    return kinds, blob, offs
+
+
+def digest_checks(salt: bytes, checks: Sequence[Tuple[str, Tuple]]) -> List[bytes]:
+    """Batched salted cache-key digests, byte-identical to
+    models/sigcache.py `_key(_parts(...))` (asserted by tests)."""
+    L = lib()
+    assert L is not None
+    n = len(checks)
+    if n == 0:
+        return []
+    kinds, blob, offs = _pack_check_parts(checks)
+    salt_a = np.frombuffer(salt, dtype=np.uint8) if salt else np.zeros(1, np.uint8)
+    out = np.zeros(32 * n, dtype=np.uint8)
+    L.nat_digest_checks(
+        _u8p(salt_a), len(salt), n, _i32p(kinds), _u8p(blob),
+        offs.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)), _u8p(out),
+    )
+    raw = out.tobytes()
+    return [raw[32 * i : 32 * i + 32] for i in range(n)]
+
+
+def digest_streams(salt: bytes, items: Sequence[Tuple[bytes, ...]]) -> List[bytes]:
+    """Batched salted digests over arbitrary part lists, byte-identical to
+    models/sigcache.py `_SaltedLRU._key` (asserted by tests)."""
+    L = lib()
+    assert L is not None
+    n = len(items)
+    if n == 0:
+        return []
+    bounds = np.empty(n + 1, dtype=np.int64)
+    bounds[0] = 0
+    parts: List[bytes] = []
+    for i, it in enumerate(items):
+        parts.extend(it)
+        bounds[i + 1] = len(parts)
+    offs = np.empty(len(parts) + 1, dtype=np.int64)
+    offs[0] = 0
+    pos = 0
+    for j, p in enumerate(parts):
+        pos += len(p)
+        offs[j + 1] = pos
+    blob = (
+        np.frombuffer(b"".join(parts), dtype=np.uint8)
+        if pos
+        else np.zeros(1, dtype=np.uint8)
+    )
+    salt_a = np.frombuffer(salt, dtype=np.uint8) if salt else np.zeros(1, np.uint8)
+    out = np.zeros(32 * n, dtype=np.uint8)
+    L.nat_digest_streams(
+        _u8p(salt_a), len(salt), n,
+        bounds.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        offs.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        _u8p(blob), _u8p(out),
+    )
+    raw = out.tobytes()
+    return [raw[32 * i : 32 * i + 32] for i in range(n)]
+
+
 class NativeTx:
     """Parsed-transaction handle (native/interp.hpp NTx). Holds the wire
     parse and the tx-wide precomputed hash aggregates on the C++ side."""
@@ -246,7 +355,10 @@ class NativeTx:
         return self._wtxid
 
     def __del__(self):
-        L = lib()
+        try:
+            L = lib()
+        except TypeError:  # interpreter shutdown tore down module globals
+            return
         if L is not None and getattr(self, "_ptr", None):
             L.nat_tx_free(self._ptr)
             self._ptr = None
@@ -287,7 +399,10 @@ class NativeSession:
         self._ptr = L.nat_session_new()
 
     def __del__(self):
-        L = lib()
+        try:
+            L = lib()
+        except TypeError:  # interpreter shutdown tore down module globals
+            return
         if L is not None and getattr(self, "_ptr", None):
             L.nat_session_free(self._ptr)
             self._ptr = None
@@ -312,23 +427,20 @@ class NativeSession:
             1 if result else 0,
         )
 
-    def take_records(self) -> List[Tuple[str, Tuple]]:
-        """Drain the records of the last verify_input call as
-        (kind, data) tuples shaped exactly like SigCheck.data."""
-        L = lib()
-        n = int(L.nat_session_records_count(self._ptr))
+    def _drain(self, count_fn, meta_fn, bytes_fn, data_fn) -> List[Tuple[str, Tuple]]:
+        n = int(count_fn(self._ptr))
         if n == 0:
             return []
         kinds = np.zeros(n, dtype=np.int32)
         parities = np.zeros(n, dtype=np.int32)
         lens = np.zeros(3 * n, dtype=np.int64)
-        L.nat_session_records_meta(
+        meta_fn(
             self._ptr, _i32p(kinds), _i32p(parities),
             lens.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
         )
-        total = int(L.nat_session_records_bytes(self._ptr))
+        total = int(bytes_fn(self._ptr))
         blob = np.zeros(max(total, 1), dtype=np.uint8)
-        L.nat_session_records_data(self._ptr, _u8p(blob))
+        data_fn(self._ptr, _u8p(blob))
         raw = blob.tobytes()
         out: List[Tuple[str, Tuple]] = []
         pos = 0
@@ -344,6 +456,92 @@ class NativeSession:
             else:
                 out.append((kind, (p0, p1, p2)))
         return out
+
+    def take_records(self) -> List[Tuple[str, Tuple]]:
+        """Drain the records of the last verify_input(s) call as
+        (kind, data) tuples shaped exactly like SigCheck.data."""
+        L = lib()
+        return self._drain(
+            L.nat_session_records_count, L.nat_session_records_meta,
+            L.nat_session_records_bytes, L.nat_session_records_data,
+        )
+
+    def take_spec(self) -> List[Tuple[str, Tuple]]:
+        """Drain the speculative CHECKMULTISIG pairings accumulated by
+        deferring verifies (cleared on drain; the seen-set persists so a
+        later re-interpretation never re-emits one)."""
+        L = lib()
+        return self._drain(
+            L.nat_session_spec_count, L.nat_session_spec_meta,
+            L.nat_session_spec_bytes, L.nat_session_spec_data,
+        )
+
+    def add_known_batch(
+        self, entries: Sequence[Tuple[str, Tuple, bool]]
+    ) -> None:
+        """Publish many resolved checks in one C call."""
+        L = lib()
+        n = len(entries)
+        if n == 0:
+            return
+        kinds, blob, offs = _pack_check_parts([(k, d) for k, d, _ in entries])
+        results = np.fromiter(
+            (1 if r else 0 for _, _, r in entries), dtype=np.int32, count=n
+        )
+        L.nat_session_add_known_batch(
+            self._ptr, n, _i32p(kinds), _u8p(blob),
+            offs.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)), _i32p(results),
+        )
+
+    def verify_inputs(
+        self,
+        ntxs: Sequence[NativeTx],
+        n_ins: Sequence[int],
+        amounts: Sequence[int],
+        script_pubkeys: Sequence[bytes],
+        flags: Sequence[int],
+        mode: int = MODE_DEFER,
+    ) -> Tuple[np.ndarray, np.ndarray, np.ndarray, List[List[Tuple[str, Tuple]]]]:
+        """Verify many inputs in ONE C call. Returns (ok, err, unknown)
+        int32 arrays plus each input's recorded checks (SigCheck-shaped).
+        Speculative records accumulate on the session; drain via take_spec."""
+        L = lib()
+        n = len(ntxs)
+        assert n == len(n_ins) == len(amounts) == len(script_pubkeys) == len(flags)
+        if n == 0:
+            return (
+                np.zeros(0, np.int32), np.zeros(0, np.int32),
+                np.zeros(0, np.int32), [],
+            )
+        tx_ptrs = (ctypes.c_void_p * n)(*[t._ptr for t in ntxs])
+        nin_a = np.asarray(n_ins, dtype=np.int32)
+        amt_a = np.asarray(amounts, dtype=np.int64)
+        flg_a = np.asarray(flags, dtype=np.int32)
+        spk_offs = np.zeros(n + 1, dtype=np.int64)
+        for i, spk in enumerate(script_pubkeys):
+            spk_offs[i + 1] = spk_offs[i] + len(spk)
+        blob_b = b"".join(script_pubkeys)
+        blob = (
+            np.frombuffer(blob_b, dtype=np.uint8)
+            if blob_b
+            else np.zeros(1, np.uint8)
+        )
+        ok = np.zeros(n, dtype=np.int32)
+        err = np.zeros(n, dtype=np.int32)
+        unk = np.zeros(n, dtype=np.int32)
+        bounds = np.zeros(n + 1, dtype=np.int64)
+        L.nat_verify_inputs(
+            self._ptr, tx_ptrs, _i32p(nin_a),
+            amt_a.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)), _u8p(blob),
+            spk_offs.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            _i32p(flg_a), mode, n, _i32p(ok), _i32p(err), _i32p(unk),
+            bounds.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        )
+        flat = self.take_records()
+        per_input = [
+            flat[int(bounds[i]) : int(bounds[i + 1])] for i in range(n)
+        ]
+        return ok, err, unk, per_input
 
     def verify_input(
         self,

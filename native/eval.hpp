@@ -538,6 +538,30 @@ inline EvalResult eval_script(Stack& stack, const Bytes& script, u32 flags,
                             }
                         }
 
+                        // Deferring mode: pre-record every pairing the
+                        // cursor walk below could reach (failure consumes a
+                        // key, success consumes both, so key-idx - sig-idx
+                        // stays in [0, nkeys-nsigs]) — one dispatch then
+                        // answers any re-interpretation's oracle reads.
+                        if (checker.mode == MODE_DEFER && checker.sess) {
+                            i64 spare = n_keys - n_sigs;
+                            Bytes sig_body, msg;
+                            for (i64 s = 0; s < n_sigs; s++) {
+                                const Bytes& vs =
+                                    stack[stack.size() - isig - (size_t)s];
+                                if (!checker.speculate_ecdsa_prep(
+                                        vs, script_code, sigversion, &sig_body,
+                                        &msg))
+                                    continue;
+                                for (i64 kk = s; kk <= s + spare; kk++) {
+                                    const Bytes& vp =
+                                        stack[stack.size() - ikey - (size_t)kk];
+                                    checker.speculate_ecdsa_record(vp, sig_body,
+                                                                   msg);
+                                }
+                            }
+                        }
+
                         bool f_success = true;
                         while (f_success && n_sigs > 0) {
                             const Bytes& vch_sig = stack[stack.size() - isig];

@@ -18,6 +18,7 @@
 #include <cstdint>
 #include <map>
 #include <memory>
+#include <set>
 #include <stdexcept>
 #include <string>
 #include <vector>
@@ -886,6 +887,15 @@ struct Record {
 struct Session {
     std::map<std::string, bool> known;
     std::vector<Record> records;
+    // Speculative CHECKMULTISIG pairings: every (sig, key) pair the cursor
+    // walk could reach (key-index minus sig-index in [0, nkeys-nsigs]) is
+    // pre-recorded here so ONE device dispatch answers every oracle read a
+    // re-interpretation can make — misaligned multisig resolves without a
+    // second host->device round-trip. Kept apart from `records` so the
+    // optimistic-verdict judgment stays exact (a false speculative pair
+    // must not reject a verdict whose own checks all held).
+    std::vector<Record> spec;
+    std::set<std::string> spec_seen;
     int unknown = 0;
 
     static std::string key(int kind, int parity, const Bytes& a, const Bytes& b,
@@ -942,28 +952,58 @@ struct Checker {
         return true;
     }
 
-    bool check_ecdsa_signature(const Bytes& sig, const Bytes& pubkey,
-                               const Bytes& script_code, int sigversion) {
-        if (sig.empty()) return false;
+    // Structural early-false gates shared by check and speculate: a sig/key
+    // failing these never reaches the curve, so there is nothing to defer.
+    static bool pubkey_plausible(const Bytes& pubkey) {
         if (pubkey.empty()) return false;
         u8 p0 = pubkey[0];
-        if (p0 == 2 || p0 == 3) {
-            if (pubkey.size() != 33) return false;
-        } else if (p0 == 4 || p0 == 6 || p0 == 7) {
-            if (pubkey.size() != 65) return false;
-        } else {
-            return false;
-        }
+        if (p0 == 2 || p0 == 3) return pubkey.size() == 33;
+        if (p0 == 4 || p0 == 6 || p0 == 7) return pubkey.size() == 65;
+        return false;
+    }
+
+    static bool ec_check_plausible(const Bytes& sig, const Bytes& pubkey) {
+        return !sig.empty() && pubkey_plausible(pubkey);
+    }
+
+    void ecdsa_sighash(const Bytes& sig, const Bytes& script_code,
+                       int sigversion, Bytes* sig_body, Bytes* msg) {
         int hash_type = sig.back();
-        Bytes sig_body(sig.begin(), sig.end() - 1);
+        sig_body->assign(sig.begin(), sig.end() - 1);
         u8 sighash[32];
         if (sigversion == SV_WITNESS_V0) {
             bip143_sighash(script_code, *tx, n_in, hash_type, amount, sighash);
         } else {
             legacy_sighash(script_code, *tx, n_in, hash_type, sighash);
         }
-        Bytes msg(sighash, sighash + 32);
+        msg->assign(sighash, sighash + 32);
+    }
+
+    bool check_ecdsa_signature(const Bytes& sig, const Bytes& pubkey,
+                               const Bytes& script_code, int sigversion) {
+        if (!ec_check_plausible(sig, pubkey)) return false;
+        Bytes sig_body, msg;
+        ecdsa_sighash(sig, script_code, sigversion, &sig_body, &msg);
         return resolve(0, 0, pubkey, sig_body, msg);
+    }
+
+    // Speculative CHECKMULTISIG pre-recording, split so the sighash (a
+    // function of the sig's hash_type only, not the key) is computed ONCE
+    // per sig: prep yields (sig_body, msg), then record per reachable key.
+    bool speculate_ecdsa_prep(const Bytes& sig, const Bytes& script_code,
+                              int sigversion, Bytes* sig_body, Bytes* msg) {
+        if (mode != MODE_DEFER || !sess) return false;
+        if (sig.empty()) return false;
+        ecdsa_sighash(sig, script_code, sigversion, sig_body, msg);
+        return true;
+    }
+
+    void speculate_ecdsa_record(const Bytes& pubkey, const Bytes& sig_body,
+                                const Bytes& msg) {
+        if (!pubkey_plausible(pubkey)) return;
+        std::string k = Session::key(0, 0, pubkey, sig_body, msg);
+        if (sess->known.count(k) || !sess->spec_seen.insert(k).second) return;
+        sess->spec.push_back(Record{0, 0, pubkey, sig_body, msg});
     }
 
     // returns ok; on hard failure sets *err

@@ -75,6 +75,67 @@ inline bool host_parse_pubkey(Lane& ln, const u8* pk, i64 len) {
     return false;
 }
 
+// Shared bodies for the records/spec drain trios and the single/batched
+// verify surfaces (one implementation, two wire paths).
+
+void fill_records_meta(const std::vector<Record>& v, i32* kinds, i32* parities,
+                       i64* lens) {
+    for (size_t i = 0; i < v.size(); i++) {
+        const Record& r = v[i];
+        kinds[i] = r.kind;
+        parities[i] = r.parity;
+        lens[3 * i] = (i64)r.p0.size();
+        lens[3 * i + 1] = (i64)r.p1.size();
+        lens[3 * i + 2] = (i64)r.p2.size();
+    }
+}
+
+i64 records_total_bytes(const std::vector<Record>& v) {
+    i64 total = 0;
+    for (const Record& r : v)
+        total += (i64)(r.p0.size() + r.p1.size() + r.p2.size());
+    return total;
+}
+
+void fill_records_data(const std::vector<Record>& v, u8* blob) {
+    size_t pos = 0;
+    for (const Record& r : v) {
+        std::memcpy(blob + pos, r.p0.data(), r.p0.size());
+        pos += r.p0.size();
+        std::memcpy(blob + pos, r.p1.data(), r.p1.size());
+        pos += r.p1.size();
+        std::memcpy(blob + pos, r.p2.data(), r.p2.size());
+        pos += r.p2.size();
+    }
+}
+
+// One input through verify_script with a (possibly deferring) checker;
+// bounds-checks n_in. Does NOT touch the session's records/unknown state —
+// callers own the clear/boundary bookkeeping.
+i32 run_verify_input(Session* sess, NTx* tx, i32 n_in, i64 amount,
+                     const u8* spk, i64 spk_len, i32 flags, i32 mode,
+                     i32* script_err, i32* unknown) {
+    if (n_in < 0 || (size_t)n_in >= tx->vin.size()) {
+        *script_err = SE_UNKNOWN_ERROR;
+        *unknown = 0;
+        return 0;
+    }
+    if (sess) sess->unknown = 0;
+    Checker checker;
+    checker.tx = tx;
+    checker.n_in = (size_t)n_in;
+    checker.amount = amount;
+    checker.mode = mode;
+    checker.sess = sess;
+    Bytes spk_b(spk, spk + spk_len);
+    EvalResult r = verify_script(tx->vin[(size_t)n_in].script_sig, spk_b,
+                                 tx->vin[(size_t)n_in].witness, (u32)flags,
+                                 checker);
+    *script_err = r.err;
+    *unknown = sess ? sess->unknown : 0;
+    return r.ok ? 1 : 0;
+}
+
 }  // namespace
 
 extern "C" {
@@ -276,36 +337,109 @@ i32 nat_session_records_count(void* s) {
 
 // kinds/parities: n each; lens: 3n (p0, p1, p2 lengths per record).
 void nat_session_records_meta(void* s, i32* kinds, i32* parities, i64* lens) {
-    auto* sess = static_cast<Session*>(s);
-    for (size_t i = 0; i < sess->records.size(); i++) {
-        const Record& r = sess->records[i];
-        kinds[i] = r.kind;
-        parities[i] = r.parity;
-        lens[3 * i] = (i64)r.p0.size();
-        lens[3 * i + 1] = (i64)r.p1.size();
-        lens[3 * i + 2] = (i64)r.p2.size();
-    }
+    fill_records_meta(static_cast<Session*>(s)->records, kinds, parities, lens);
 }
 
 void nat_session_records_data(void* s, u8* blob) {
-    auto* sess = static_cast<Session*>(s);
-    size_t pos = 0;
-    for (const Record& r : sess->records) {
-        std::memcpy(blob + pos, r.p0.data(), r.p0.size());
-        pos += r.p0.size();
-        std::memcpy(blob + pos, r.p1.data(), r.p1.size());
-        pos += r.p1.size();
-        std::memcpy(blob + pos, r.p2.data(), r.p2.size());
-        pos += r.p2.size();
-    }
+    fill_records_data(static_cast<Session*>(s)->records, blob);
 }
 
 i64 nat_session_records_bytes(void* s) {
+    return records_total_bytes(static_cast<Session*>(s)->records);
+}
+
+// --- Speculative-record drain (Session::spec; same wire shape as the
+// records_* trio). spec_seen persists so re-interpretations never re-emit.
+
+i32 nat_session_spec_count(void* s) {
+    return (i32)static_cast<Session*>(s)->spec.size();
+}
+
+void nat_session_spec_meta(void* s, i32* kinds, i32* parities, i64* lens) {
+    fill_records_meta(static_cast<Session*>(s)->spec, kinds, parities, lens);
+}
+
+i64 nat_session_spec_bytes(void* s) {
+    return records_total_bytes(static_cast<Session*>(s)->spec);
+}
+
+void nat_session_spec_data(void* s, u8* blob) {
     auto* sess = static_cast<Session*>(s);
-    i64 total = 0;
-    for (const Record& r : sess->records)
-        total += (i64)(r.p0.size() + r.p1.size() + r.p2.size());
-    return total;
+    fill_records_data(sess->spec, blob);
+    sess->spec.clear();  // drained; spec_seen persists across rounds
+}
+
+// Batched oracle publish: check i's parts are blob[offs[3i]..offs[3i+1]) etc.
+// (Record part order: ecdsa pubkey|sig|msg, schnorr pk32|sig64|msg,
+// tweak q32|internal32|tweak32); kinds[i]&0xff is the kind, bit 8 the
+// tweak parity; results[i] the verdict.
+void nat_session_add_known_batch(void* s, i32 n, const i32* kinds,
+                                 const u8* blob, const i64* offs,
+                                 const i32* results) {
+    auto* sess = static_cast<Session*>(s);
+    for (i32 i = 0; i < n; i++) {
+        const u8* p0 = blob + offs[3 * i];
+        const u8* p1 = blob + offs[3 * i + 1];
+        const u8* p2 = blob + offs[3 * i + 2];
+        Bytes a(p0, p1), b(p1, p2), c(p2, blob + offs[3 * i + 3]);
+        sess->known[Session::key(kinds[i] & 0xff, (kinds[i] >> 8) & 1, a, b,
+                                 c)] = results[i] != 0;
+    }
+}
+
+// Batched salted cache-key digests, byte-identical to the Python
+// models/sigcache.py `_key(_parts(kind, data))` stream:
+//   sha256(salt || [len(part) as 4-byte LE || part]...)
+// with parts = [kind-name, data...] and the tweak parity serialized as an
+// 8-byte signed little-endian int between q32 and internal32.
+void nat_digest_checks(const u8* salt, i64 salt_len, i32 n, const i32* kinds,
+                       const u8* blob, const i64* offs, u8* out) {
+    static const char* NAMES[3] = {"ecdsa", "schnorr", "tweak"};
+    for (i32 i = 0; i < n; i++) {
+        Sha256 h;
+        h.write(salt, (size_t)salt_len);
+        int kind = kinds[i] & 0xff;
+        auto part = [&h](const u8* p, size_t len) {
+            u8 lb[4] = {u8(len), u8(len >> 8), u8(len >> 16), u8(len >> 24)};
+            h.write(lb, 4);
+            h.write(p, len);
+        };
+        const char* name = NAMES[kind];
+        part(reinterpret_cast<const u8*>(name), std::strlen(name));
+        const u8* p0 = blob + offs[3 * i];
+        const u8* p1 = blob + offs[3 * i + 1];
+        const u8* p2 = blob + offs[3 * i + 2];
+        const u8* p3 = blob + offs[3 * i + 3];
+        part(p0, (size_t)(p1 - p0));
+        if (kind == KIND_TWEAK) {
+            u8 pb[8] = {u8((kinds[i] >> 8) & 1), 0, 0, 0, 0, 0, 0, 0};
+            part(pb, 8);
+        }
+        part(p1, (size_t)(p2 - p1));
+        part(p2, (size_t)(p3 - p2));
+        h.finalize(out + 32 * (size_t)i);
+    }
+}
+
+// Generic batched salted digests over variable part lists (the script-
+// execution-cache keys): item i hashes parts part_bounds[i]..part_bounds[i+1)
+// with the models/sigcache.py `_key` stream layout
+// (sha256(salt || [len(part) as 4-byte LE || part]...)); part j's bytes are
+// blob[part_offs[j]..part_offs[j+1]).
+void nat_digest_streams(const u8* salt, i64 salt_len, i32 n,
+                        const i64* part_bounds, const i64* part_offs,
+                        const u8* blob, u8* out) {
+    for (i32 i = 0; i < n; i++) {
+        Sha256 h;
+        h.write(salt, (size_t)salt_len);
+        for (i64 j = part_bounds[i]; j < part_bounds[i + 1]; j++) {
+            size_t len = (size_t)(part_offs[j + 1] - part_offs[j]);
+            u8 lb[4] = {u8(len), u8(len >> 8), u8(len >> 16), u8(len >> 24)};
+            h.write(lb, 4);
+            h.write(blob + part_offs[j], len);
+        }
+        h.finalize(out + 32 * (size_t)i);
+    }
 }
 
 void* nat_tx_parse(const u8* data, i64 len) {
@@ -355,31 +489,32 @@ i32 nat_verify_input(void* s, void* txp, i32 n_in, i64 amount, const u8* spk,
                      i64 spk_len, i32 flags, i32 mode, i32* script_err,
                      i32* unknown) {
     auto* sess = static_cast<Session*>(s);
-    auto* tx = static_cast<NTx*>(txp);
-    // Defensive bounds check: the Python callers validate nIn first, but an
-    // out-of-range index must never reach the vin[] access below.
-    if (n_in < 0 || (size_t)n_in >= tx->vin.size()) {
-        *script_err = SE_UNKNOWN_ERROR;
-        *unknown = 0;
-        return 0;
+    if (sess) sess->records.clear();
+    return run_verify_input(sess, static_cast<NTx*>(txp), n_in, amount, spk,
+                            spk_len, flags, mode, script_err, unknown);
+}
+
+// Batched verify: n inputs in one call (the per-call ctypes cost of the
+// single-input surface dominates a 3k-input block; this removes it).
+// txs[i]/n_ins[i]/amounts[i]/flags[i] per input; input i's scriptPubKey is
+// spk_blob[spk_offs[i]..spk_offs[i+1]). Outputs per input: ok/err/unk, and
+// rec_bounds (n+1 entries) delimiting its slice of the session's records
+// (drained afterwards via the records_* trio). Speculative records
+// accumulate session-wide; drain via the spec_* trio.
+void nat_verify_inputs(void* s, void** txs, const i32* n_ins,
+                       const i64* amounts, const u8* spk_blob,
+                       const i64* spk_offs, const i32* flags, i32 mode, i32 n,
+                       i32* ok, i32* err, i32* unk, i64* rec_bounds) {
+    auto* sess = static_cast<Session*>(s);
+    if (sess) sess->records.clear();
+    rec_bounds[0] = 0;
+    for (i32 i = 0; i < n; i++) {
+        ok[i] = run_verify_input(sess, static_cast<NTx*>(txs[i]), n_ins[i],
+                                 amounts[i], spk_blob + spk_offs[i],
+                                 spk_offs[i + 1] - spk_offs[i], flags[i], mode,
+                                 &err[i], &unk[i]);
+        rec_bounds[i + 1] = sess ? (i64)sess->records.size() : 0;
     }
-    if (sess) {
-        sess->records.clear();
-        sess->unknown = 0;
-    }
-    Checker checker;
-    checker.tx = tx;
-    checker.n_in = (size_t)n_in;
-    checker.amount = amount;
-    checker.mode = mode;
-    checker.sess = sess;
-    Bytes spk_b(spk, spk + spk_len);
-    EvalResult r = verify_script(tx->vin[(size_t)n_in].script_sig, spk_b,
-                                 tx->vin[(size_t)n_in].witness, (u32)flags,
-                                 checker);
-    *script_err = r.err;
-    *unknown = sess ? sess->unknown : 0;
-    return r.ok ? 1 : 0;
 }
 
 }  // extern "C"

@@ -1,0 +1,159 @@
+"""Standalone pallas-vs-XLA equality checks, run in a FRESH process.
+
+Why a subprocess: the interpret-mode pallas compiles are the largest XLA
+programs in the suite, and XLA:CPU segfaults compiling (or cache-writing)
+them late in a long-lived pytest process that has already compiled ~100
+other programs — reproducibly at `tests/test_pallas_kernel.py`, and
+reproducibly NOT when the same compile runs in a clean process (the crash
+is inside jaxlib, with the native core disabled too). Each check here
+runs in its own interpreter via `test_pallas_kernel.py`'s subprocess
+wrappers, which also warms the persistent compile cache for direct runs.
+
+Usage: python tests/pallas_equality_check.py {small|production|collision}
+Exit code 0 = the equality/deferral assertions passed.
+"""
+
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import numpy as np  # noqa: E402
+
+
+def check_small() -> None:
+    """tile=8 adversarial mix: bit-equality with the XLA kernel."""
+    import __graft_entry__ as ge
+    from bitcoinconsensus_tpu.crypto.jax_backend import _verify_kernel
+    from bitcoinconsensus_tpu.ops.pallas_kernel import verify_tiles
+
+    fields, want_odd, parity, has_t2, neg1, neg2, valid = ge._example_arrays(8)
+    fields = np.array(fields)
+    want_odd = np.array(want_odd)
+    valid = np.array(valid)
+    neg1 = np.array(neg1)
+
+    fields[3, 3, 0] ^= 1  # corrupt lane 3's target -> must fail
+    valid[5] = False  # structurally invalid lane
+    fields[7, 2, 0] ^= 1  # perturb lane 7's pubkey x (likely non-residue)
+    want_odd[2] ^= 1  # wrong y parity for lane 2's pubkey -> wrong R
+    neg1[4] ^= 1  # flip a GLV half sign -> wrong R for lane 4
+
+    want = np.asarray(
+        _verify_kernel(fields, want_odd, parity, has_t2, neg1, neg2, valid)
+    )
+    got_ok, got_needs = verify_tiles(
+        fields, want_odd, parity, has_t2, neg1, neg2, valid,
+        tile=8, interpret=True,
+    )
+    got = np.asarray(got_ok)
+    assert not np.asarray(got_needs).any()  # no group-law deferrals here
+    assert (got == want).all(), (got, want)
+    assert not want[3] and not want[5] and not want[2] and not want[4]
+    assert want[0] and want[1]
+
+
+def check_production() -> None:
+    """Equality at the PRODUCTION tile (LANE_TILE=512): multi-kind lanes
+    (ECDSA/Schnorr/tweak), adversarial corruptions of every flavor, and —
+    crucially — the w=128 Fermat narrowing in _tile_batch_inv, which the
+    tile=8 check can never reach (w=min(128, T))."""
+    import __graft_entry__ as ge
+    from bitcoinconsensus_tpu.crypto.jax_backend import (
+        SigCheck,
+        TpuSecpVerifier,
+        _verify_kernel,
+    )
+    from bitcoinconsensus_tpu.ops.pallas_kernel import LANE_TILE, verify_tiles
+
+    checks = ge._example_checks(LANE_TILE)
+    # Structurally-invalid lanes (host-rejected, valid=False): bad ECDSA
+    # pubkey prefix; short Schnorr pubkey.
+    d = checks[9].data
+    checks[9] = SigCheck("ecdsa", (b"\x05" + d[0][1:], d[1], d[2]))
+    d = checks[10].data
+    checks[10] = SigCheck("schnorr", (d[0][:31], d[1], d[2]))
+
+    v = TpuSecpVerifier(min_batch=LANE_TILE)
+    args = v._pack_lanes(v._prep_lanes(checks))
+    fields, want_odd, parity, has_t2, neg1, neg2, valid = (
+        np.array(a) for a in args
+    )
+    assert not valid[9] and not valid[10]
+    # Device-level corruptions across kinds (lane i: i%3==0 ECDSA,
+    # 1 Schnorr, 2 tweak).
+    fields[0, 3, 0] ^= 1  # ECDSA target
+    fields[1, 3, 0] ^= 1  # Schnorr target
+    fields[2, 3, 0] ^= 1  # tweak target
+    fields[3, 2, 0] ^= 1  # ECDSA pubkey x perturbed (likely non-residue)
+    want_odd[6] ^= 1  # ECDSA wrong y-lift parity
+    parity[4] ^= 1  # Schnorr R.y parity requirement flipped
+    neg1[12] ^= 1  # GLV half sign flip
+
+    want = np.asarray(
+        _verify_kernel(fields, want_odd, parity, has_t2, neg1, neg2, valid)
+    )
+    got_ok, got_needs = verify_tiles(
+        fields, want_odd, parity, has_t2, neg1, neg2, valid,
+        tile=LANE_TILE, interpret=True,
+    )
+    got = np.asarray(got_ok)
+    assert not np.asarray(got_needs).any()
+    assert (got == want).all(), np.nonzero(got != want)
+    bad = [0, 1, 2, 3, 4, 6, 9, 10, 12]
+    assert not want[bad].any(), want[bad]
+    mask = np.ones(LANE_TILE, dtype=bool)
+    mask[bad] = False
+    assert want[mask].all(), np.nonzero(~want & mask)
+
+
+def check_collision() -> None:
+    """A crafted equal-points taproot tweak: the pallas fast adds must
+    flag the lane needs_host (ok=False), others unaffected; the XLA
+    complete kernel resolves it TRUE directly."""
+    import __graft_entry__ as ge
+    from bitcoinconsensus_tpu.crypto import secp_host as H
+    from bitcoinconsensus_tpu.crypto.jax_backend import (
+        SigCheck,
+        TpuSecpVerifier,
+        _verify_kernel,
+    )
+    from bitcoinconsensus_tpu.ops.pallas_kernel import verify_tiles
+
+    qx, qy = H.G.mul(2).to_affine()
+    collision = SigCheck(
+        "tweak",
+        (
+            qx.to_bytes(32, "big"),
+            qy & 1,
+            H.G_X.to_bytes(32, "big"),
+            (1).to_bytes(32, "big"),
+        ),
+    )
+    checks = ge._example_checks(7)
+    checks[0] = collision
+    v = TpuSecpVerifier(min_batch=8)
+    args = v._pack_lanes(v._prep_lanes(checks))
+
+    want = np.asarray(_verify_kernel(*args))
+    assert want[:7].all()  # XLA complete kernel: collision resolves TRUE
+
+    ok, needs = verify_tiles(*args, tile=8, interpret=True)
+    ok, needs = np.asarray(ok), np.asarray(needs)
+    assert needs[0] and not ok[0], "collision lane must defer"
+    assert not needs[1:7].any() and ok[1:7].all(), "others unaffected"
+
+
+CHECKS = {
+    "small": check_small,
+    "production": check_production,
+    "collision": check_collision,
+}
+
+if __name__ == "__main__":
+    name = sys.argv[1]
+    CHECKS[name]()
+    print(f"pallas equality check '{name}': PASS")

@@ -3,12 +3,18 @@
 The pallas path (`ops/pallas_kernel.py`) is the TPU production backend;
 the XLA kernel is the reference semantics (itself oracle-tested against
 `crypto/secp_host.py`). On CPU the pallas kernel runs in interpreter
-mode — slow, so the batch is small and the case mix is adversarial:
-valid ECDSA/Schnorr/tweak lanes, corrupted targets, invalid pubkeys
-(non-residue x), structurally-invalid lanes, and r+n secondary targets.
+mode; each equality check executes in a FRESH subprocess
+(`pallas_equality_check.py`) because the interpret-mode compiles are the
+largest programs in the suite and XLA:CPU reproducibly segfaults
+compiling them late in a long-lived pytest process (clean-process runs
+of the identical compile pass; the crash reproduces with the native core
+disabled, i.e. it is jaxlib-internal). The subprocess also warms the
+persistent compile cache, so repeat runs are fast.
 """
 
 import os
+import subprocess
+import sys
 
 import numpy as np
 import pytest
@@ -21,103 +27,44 @@ pytestmark = pytest.mark.skipif(
     not RUN, reason="pallas interpreter equality disabled (PALLAS_INTERPRET_TESTS=0)"
 )
 
+_HELPER = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                       "pallas_equality_check.py")
+
+
+def _run_check(name: str, timeout: int = 1800) -> None:
+    proc = subprocess.run(
+        [sys.executable, _HELPER, name],
+        capture_output=True, text=True, timeout=timeout,
+    )
+    assert proc.returncode == 0, (
+        f"pallas equality check '{name}' failed (rc={proc.returncode})\n"
+        f"stdout:\n{proc.stdout}\nstderr:\n{proc.stderr[-4000:]}"
+    )
+
 
 def test_pallas_matches_xla_kernel():
-    import __graft_entry__ as ge
-    from bitcoinconsensus_tpu.crypto.jax_backend import _verify_kernel
-    from bitcoinconsensus_tpu.ops.pallas_kernel import verify_tiles
-
-    # 8 lanes: the interpreter path is minutes-per-lane-tile slow; the
-    # adversarial case mix below only needs indices 0..7.
-    fields, want_odd, parity, has_t2, neg1, neg2, valid = ge._example_arrays(8)
-    fields = np.array(fields)
-    want_odd = np.array(want_odd)
-    valid = np.array(valid)
-    neg1 = np.array(neg1)
-
-    fields[3, 3, 0] ^= 1  # corrupt lane 3's target -> must fail
-    valid[5] = False  # structurally invalid lane
-    fields[7, 2, 0] ^= 1  # perturb lane 7's pubkey x (likely non-residue)
-    want_odd[2] ^= 1  # wrong y parity for lane 2's pubkey -> wrong R
-    neg1[4] ^= 1  # flip a GLV half sign -> wrong R for lane 4
-
-    want = np.asarray(
-        _verify_kernel(fields, want_odd, parity, has_t2, neg1, neg2, valid)
-    )
-    got_ok, got_needs = verify_tiles(
-        fields, want_odd, parity, has_t2, neg1, neg2, valid,
-        tile=8, interpret=True,
-    )
-    got = np.asarray(got_ok)
-    assert not np.asarray(got_needs).any()  # no group-law deferrals here
-    assert (got == want).all(), (got, want)
-    assert not want[3] and not want[5] and not want[2] and not want[4]
-    assert want[0] and want[1]
+    """tile=8 adversarial mix, bit-equality (fresh process)."""
+    _run_check("small")
 
 
 def test_pallas_production_shape_matches_xla():
-    """Equality at the PRODUCTION tile (LANE_TILE=512): multi-kind lanes
-    (ECDSA/Schnorr/tweak), adversarial corruptions of every flavor, and —
-    crucially — the w=128 Fermat narrowing in _tile_batch_inv, which the
-    tile=8 test can never reach (w=min(128, T))."""
+    """PRODUCTION tile (LANE_TILE=512) equality incl. the w=128 Fermat
+    narrowing in _tile_batch_inv (fresh process)."""
+    _run_check("production")
+
+
+def test_exceptional_case_deferred_to_host():
+    """Crafted equal-points tweak: device-side deferral flag asserted in
+    the subprocess; the verify_checks host-fixup loop asserted here
+    in-process (it runs the XLA kernel, no pallas compile)."""
+    _run_check("collision")
+
     import __graft_entry__ as ge
-    from bitcoinconsensus_tpu.crypto.jax_backend import (
-        SigCheck,
-        TpuSecpVerifier,
-        _verify_kernel,
-    )
-    from bitcoinconsensus_tpu.ops.pallas_kernel import LANE_TILE, verify_tiles
-
-    checks = ge._example_checks(LANE_TILE)
-    # Structurally-invalid lanes (host-rejected, valid=False): bad ECDSA
-    # pubkey prefix; short Schnorr pubkey.
-    d = checks[9].data
-    checks[9] = SigCheck("ecdsa", (b"\x05" + d[0][1:], d[1], d[2]))
-    d = checks[10].data
-    checks[10] = SigCheck("schnorr", (d[0][:31], d[1], d[2]))
-
-    v = TpuSecpVerifier(min_batch=LANE_TILE)
-    args = v._pack_lanes(v._prep_lanes(checks))
-    fields, want_odd, parity, has_t2, neg1, neg2, valid = (
-        np.array(a) for a in args
-    )
-    assert not valid[9] and not valid[10]
-    # Device-level corruptions across kinds (lane i: i%3==0 ECDSA,
-    # 1 Schnorr, 2 tweak).
-    fields[0, 3, 0] ^= 1  # ECDSA target
-    fields[1, 3, 0] ^= 1  # Schnorr target
-    fields[2, 3, 0] ^= 1  # tweak target
-    fields[3, 2, 0] ^= 1  # ECDSA pubkey x perturbed (likely non-residue)
-    want_odd[6] ^= 1  # ECDSA wrong y-lift parity
-    parity[4] ^= 1  # Schnorr R.y parity requirement flipped
-    neg1[12] ^= 1  # GLV half sign flip
-
-    want = np.asarray(
-        _verify_kernel(fields, want_odd, parity, has_t2, neg1, neg2, valid)
-    )
-    got_ok, got_needs = verify_tiles(
-        fields, want_odd, parity, has_t2, neg1, neg2, valid,
-        tile=LANE_TILE, interpret=True,
-    )
-    got = np.asarray(got_ok)
-    assert not np.asarray(got_needs).any()
-    assert (got == want).all(), np.nonzero(got != want)
-    bad = [0, 1, 2, 3, 4, 6, 9, 10, 12]
-    assert not want[bad].any(), want[bad]
-    mask = np.ones(LANE_TILE, dtype=bool)
-    mask[bad] = False
-    assert want[mask].all(), np.nonzero(~want & mask)
-
-
-def _collision_tweak_check():
-    """A VALID taproot-tweak check crafted to hit the equal-points case:
-    internal = G (x-only), t = 1 -> Q = 1·G + 1·G, so the kernel's final
-    join adds G to G — the exact group-law case the fast adds defer."""
     from bitcoinconsensus_tpu.crypto import secp_host as H
-    from bitcoinconsensus_tpu.crypto.jax_backend import SigCheck
+    from bitcoinconsensus_tpu.crypto.jax_backend import SigCheck, TpuSecpVerifier
 
     qx, qy = H.G.mul(2).to_affine()
-    return SigCheck(
+    collision = SigCheck(
         "tweak",
         (
             qx.to_bytes(32, "big"),
@@ -126,28 +73,9 @@ def _collision_tweak_check():
             (1).to_bytes(32, "big"),
         ),
     )
-
-
-def test_exceptional_case_deferred_to_host():
-    """The pallas fast adds flag crafted scalar collisions as needs_host
-    (ok=False on device); the XLA complete kernel resolves them directly;
-    verify_checks' host fixup restores the exact verdict."""
-    import __graft_entry__ as ge
-    from bitcoinconsensus_tpu.crypto.jax_backend import TpuSecpVerifier, _verify_kernel
-    from bitcoinconsensus_tpu.ops.pallas_kernel import verify_tiles
-
     checks = ge._example_checks(7)
-    checks[0] = _collision_tweak_check()
+    checks[0] = collision
     v = TpuSecpVerifier(min_batch=8)
-    args = v._pack_lanes(v._prep_lanes(checks))
-
-    want = np.asarray(_verify_kernel(*args))
-    assert want[:7].all()  # XLA complete kernel: collision resolves TRUE
-
-    ok, needs = verify_tiles(*args, tile=8, interpret=True)
-    ok, needs = np.asarray(ok), np.asarray(needs)
-    assert needs[0] and not ok[0], "collision lane must defer"
-    assert not needs[1:7].any() and ok[1:7].all(), "others unaffected"
 
     # Full fixup loop through verify_checks (device part simulated: the
     # CPU test env runs the XLA kernel, so inject the pallas-shaped
