@@ -22,6 +22,7 @@ Like the reference, all hashes are held in wire byte order.
 
 from __future__ import annotations
 
+import os
 import struct
 from dataclasses import dataclass
 from typing import List, Optional, Tuple
@@ -36,6 +37,7 @@ __all__ = [
     "BlockHeader",
     "Block",
     "merkle_root",
+    "merkle_root_device",
     "block_merkle_root",
     "block_witness_merkle_root",
     "bits_to_target",
@@ -145,6 +147,49 @@ def merkle_root(hashes: List[bytes]) -> Tuple[bytes, bool]:
     return level[0], mutated
 
 
+def merkle_root_device(hashes: List[bytes]) -> Tuple[bytes, bool]:
+    """`merkle_root` computed on device via the batched SHA-256 kernel
+    (`ops/sha256.sha256d_fixed`): every level is one lane-parallel
+    double-SHA over (n/2, 64)-byte pairs, levels chained device-side with
+    a single readback at the root. Bit-identical to the host version
+    (asserted by tests/test_ops_sha256.py), including the CVE-2012-2459
+    `mutated` flag with the host's exact don't-count-the-odd-duplicate
+    semantics.
+
+    When to use which: each level's shape compiles once, so this pays off
+    for recurring block sizes on co-located chips where dispatch is ~µs;
+    over a high-RTT tunnel the single readback still costs one link
+    round-trip, which exceeds the ~1 ms the native/host path needs for a
+    whole mainnet block. `check_block(device_merkle=True)` or
+    BITCOINCONSENSUS_TPU_DEVICE_MERKLE=1 selects it.
+    """
+    import numpy as np
+    import jax.numpy as jnp
+
+    from ..ops.sha256 import sha256d_fixed
+
+    if not hashes:
+        return b"\x00" * 32, False
+    level = jnp.asarray(
+        np.frombuffer(b"".join(hashes), dtype=np.uint8).reshape(len(hashes), 32)
+    )
+    mutated = jnp.zeros((), dtype=bool)
+    while level.shape[0] > 1:
+        n = level.shape[0]
+        # merkle.cpp:45-64 checks sibling equality BEFORE duplicating the
+        # odd tail, so the synthetic last pair never counts as mutation.
+        n_even = n & ~1
+        eq = jnp.all(
+            level[0:n_even:2] == level[1:n_even:2], axis=1
+        )
+        mutated = mutated | jnp.any(eq)
+        if n & 1:
+            level = jnp.concatenate([level, level[-1:]], axis=0)
+            n += 1
+        level = sha256d_fixed(level.reshape(n // 2, 64))
+    return bytes(np.asarray(level[0])), bool(np.asarray(mutated))
+
+
 def block_merkle_root(block: Block) -> Tuple[bytes, bool]:
     """BlockMerkleRoot: txid leaves (consensus/merkle.cpp:66-73)."""
     return merkle_root([tx.txid for tx in block.vtx])
@@ -232,18 +277,27 @@ def check_block(
     check_pow: bool = True,
     check_merkle: bool = True,
     pow_limit: int = POW_LIMIT_MAINNET,
+    device_merkle: Optional[bool] = None,
 ) -> Tuple[bool, Optional[str]]:
     """Context-free CheckBlock (validation.cpp:3402-3474).
 
     Returns (ok, reject-reason); reasons match the reference's strings.
     Witness rules are contextual in the reference (segwit activation); use
     `check_witness_commitment` alongside for post-segwit blocks.
+    `device_merkle` selects the batched device SHA-256 merkle backend
+    (default: BITCOINCONSENSUS_TPU_DEVICE_MERKLE env; see
+    `merkle_root_device` for when it pays off).
     """
     if check_pow and not check_proof_of_work(block.hash, block.header.bits, pow_limit):
         return False, "high-hash"
 
     if check_merkle:
-        root, mutated = block_merkle_root(block)
+        if device_merkle is None:
+            device_merkle = os.environ.get(
+                "BITCOINCONSENSUS_TPU_DEVICE_MERKLE", ""
+            ) in ("1", "on")
+        root_fn = merkle_root_device if device_merkle else merkle_root
+        root, mutated = root_fn([tx.txid for tx in block.vtx])
         if block.header.merkle_root != root:
             return False, "bad-txnmrklroot"
         if mutated:

@@ -192,6 +192,15 @@ class Tx:
         self._ser[use_witness] = out
         return out
 
+    def invalidate_caches(self) -> None:
+        """Drop the memoized ids AND serializations. The class is
+        immutable by contract, but fixture builders (utils/blockgen.py)
+        construct-then-sign; any such mutation must call this — resetting
+        _txid/_wtxid alone leaves `serialize()` returning stale bytes."""
+        self._txid = None
+        self._wtxid = None
+        self._ser.clear()
+
     # -- identity -----------------------------------------------------------
     @property
     def txid(self) -> bytes:

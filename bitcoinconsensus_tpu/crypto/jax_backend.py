@@ -47,6 +47,7 @@ import jax
 import jax.numpy as jnp
 
 from ..utils.hashes import tagged_hash
+from ..utils.gcpause import gc_paused
 from ..utils.profiling import Phases
 from ..ops.limbs import (
     MASK,
@@ -203,27 +204,38 @@ def _prep_ecdsa(lane: _Lane, pubkey: bytes, sig_der: bytes, msg32: bytes):
     return r, s, int.from_bytes(msg32, "big") % N
 
 
-def _prep_schnorr(lane: _Lane, pubkey32: bytes, sig64: bytes, msg32: bytes):
-    """BIP340 verify host half (modules/schnorrsig/main_impl.h:190-237)."""
+def _prep_schnorr(
+    lane: _Lane, pubkey32: bytes, sig64: bytes, msg32: bytes,
+    defer_challenge: bool = False,
+):
+    """BIP340 verify host half (modules/schnorrsig/main_impl.h:190-237).
+
+    With `defer_challenge` the structural work happens here but the
+    challenge hash is left to the caller (returns the (r32, px32, m32)
+    triple to feed `ops/sha256.bip340_challenge` in one device batch;
+    caller must then `lane.set_b((N - e) % N)`)."""
     if len(pubkey32) != 32 or len(sig64) != 64:
-        return
+        return None
     px = int.from_bytes(pubkey32, "big")
     if px >= P_INT:
-        return
+        return None
     r = int.from_bytes(sig64[:32], "big")
     s = int.from_bytes(sig64[32:], "big")
     if r >= P_INT or s >= N:
-        return
-    e = int.from_bytes(
-        tagged_hash("BIP0340/challenge", sig64[:32] + pubkey32 + msg32), "big"
-    ) % N
+        return None
     lane.px = px
     lane.want_odd = 0  # BIP340 lift_x: even y; device checks existence
     lane.a = s
-    lane.set_b((N - e) % N)  # (n-e)·P = -e·P
     lane.t1 = r
     lane.parity = 0  # require even R.y
     lane.valid = True
+    if defer_challenge:
+        return (sig64[:32], pubkey32, msg32)
+    e = int.from_bytes(
+        tagged_hash("BIP0340/challenge", sig64[:32] + pubkey32 + msg32), "big"
+    ) % N
+    lane.set_b((N - e) % N)  # (n-e)·P = -e·P
+    return None
 
 
 def _prep_tweak(lane: _Lane, tweaked32: bytes, parity: int, internal32: bytes,
@@ -329,6 +341,7 @@ class TpuSecpVerifier:
         min_batch: int = 8,
         chunk: int = 1 << 13,
         pad_step: Optional[int] = None,
+        device_challenge: Optional[bool] = None,
     ):
         """`pad_step`: cap the power-of-two pad ladder at the next multiple
         of this step (small batches still pad to the ladder). Every distinct
@@ -343,6 +356,16 @@ class TpuSecpVerifier:
             raise ValueError(
                 "pad_step must be a positive multiple of the 512-lane tile"
             )
+        # BIP340 challenges via the batched device SHA-256 (ops/sha256) in
+        # the Python prep path; the native C++ prep hashes in-process (the
+        # same midstate trick at memory speed), so this only matters when
+        # the native core is absent — and pays when dispatch is cheap
+        # (co-located chips / CPU backend), not across a high-RTT tunnel.
+        if device_challenge is None:
+            device_challenge = os.environ.get(
+                "BITCOINCONSENSUS_TPU_DEVICE_SHA", ""
+            ) in ("1", "on")
+        self._device_challenge = bool(device_challenge)
         self._kernel = jax.jit(_verify_kernel)
         self._min_batch = min_batch
         self._chunk = chunk
@@ -383,13 +406,18 @@ class TpuSecpVerifier:
     def _prep_lanes(self, checks: Sequence[SigCheck]) -> List["_Lane"]:
         lanes = [_Lane() for _ in checks]
         ecdsa_pending = []  # (lane, r, s, m)
+        schnorr_pending = []  # (lane, r32, px32, m32) — device-challenge mode
         for lane, chk in zip(lanes, checks):
             if chk.kind == "ecdsa":
                 got = _prep_ecdsa(lane, *chk.data)
                 if got is not None:
                     ecdsa_pending.append((lane, *got))
             elif chk.kind == "schnorr":
-                _prep_schnorr(lane, *chk.data)
+                trip = _prep_schnorr(
+                    lane, *chk.data, defer_challenge=self._device_challenge
+                )
+                if trip is not None:
+                    schnorr_pending.append((lane, *trip))
             else:
                 _prep_tweak(lane, *chk.data)
         if ecdsa_pending:
@@ -397,6 +425,25 @@ class TpuSecpVerifier:
             for (lane, r, _s, m), sinv in zip(ecdsa_pending, sinvs):
                 lane.a = m * sinv % N  # u1
                 lane.set_b(r * sinv % N)  # u2
+        if schnorr_pending:
+            # ONE batched device dispatch for every BIP340 challenge
+            # (ops/sha256 midstate path) instead of per-lane host hashing;
+            # bit-identical (tests/test_ops_sha256.py) — the GLV split of
+            # (n - e) still happens host-side where the wide-int math is.
+            from ..ops.sha256 import bip340_challenge
+
+            stack = np.stack(
+                [
+                    np.frombuffer(r + px + m, dtype=np.uint8)
+                    for _, r, px, m in schnorr_pending
+                ]
+            )
+            digests = np.asarray(
+                bip340_challenge(stack[:, :32], stack[:, 32:64], stack[:, 64:])
+            )
+            for (lane, *_), d in zip(schnorr_pending, digests):
+                e = int.from_bytes(d.tobytes(), "big") % N
+                lane.set_b((N - e) % N)  # (n-e)·P = -e·P
         return lanes
 
     def verify_checks(self, checks: Sequence[SigCheck]) -> np.ndarray:
@@ -404,10 +451,16 @@ class TpuSecpVerifier:
 
         Fully pipelined per chunk: while the device crunches chunk k, the
         host parses/packs chunk k+1 (JAX async dispatch); the roundtrip
-        sync cost is paid once, at the end.
+        sync cost is paid once, at the end. Cycle collection is paused
+        for the duration (utils/gcpause.py — full GC passes over the JAX
+        heap otherwise dominate the host-side cost of large batches).
         """
         if not checks:
             return np.zeros(0, dtype=bool)
+        with gc_paused():
+            return self._verify_checks_impl(checks)
+
+    def _verify_checks_impl(self, checks: Sequence[SigCheck]) -> np.ndarray:
         pending = []  # (device_result, start, count)
         for start in range(0, len(checks), self._chunk):
             sub_checks = checks[start : start + self._chunk]

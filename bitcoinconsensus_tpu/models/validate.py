@@ -45,6 +45,7 @@ from ..core.script import (
 from ..core.tx import COIN, MAX_MONEY, OutPoint, Tx, TxOut
 from ..core.tx_check import WITNESS_SCALE_FACTOR
 from ..crypto.jax_backend import TpuSecpVerifier
+from ..utils.gcpause import gc_paused
 from .batch import BatchItem, BatchResult, verify_batch
 from .sigcache import ScriptExecutionCache, SigCache
 
@@ -196,7 +197,22 @@ def connect_block(
 
     The view is mutated only when every check passes. `flags` defaults to
     the mainnet `height_to_flags(height, extended=True)` schedule.
+
+    Cycle collection is paused for the duration (utils/gcpause.py; see
+    verify_batch) — the accounting loops over thousands of inputs
+    otherwise pay repeated full GC passes over the JAX heap.
     """
+    with gc_paused():
+        return _connect_block_impl(
+            block, coins, height, flags, verifier, check_pow, check_scripts,
+            enforce_witness_commitment, pow_limit, sig_cache, script_cache,
+        )
+
+
+def _connect_block_impl(
+    block, coins, height, flags, verifier, check_pow, check_scripts,
+    enforce_witness_commitment, pow_limit, sig_cache, script_cache,
+) -> ConnectResult:
     if flags is None:
         flags = height_to_flags(height, extended=True)
     if verifier is None and check_scripts:

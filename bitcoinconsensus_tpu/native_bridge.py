@@ -36,6 +36,11 @@ _NATIVE_DIR = os.path.join(
     os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "native"
 )
 _SO_PATH = os.path.join(_NATIVE_DIR, "libnat.so")
+# Installed-package location: setup.py compiles the core into the wheel
+# as bitcoinconsensus_tpu/_native/libnat.so (no source tree at runtime).
+_PACKAGED_SO = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "_native", "libnat.so"
+)
 _SOURCES = ("nat.cpp", "secp.hpp", "sha256.hpp", "hash_extra.hpp", "interp.hpp", "eval.hpp")
 
 _lock = threading.Lock()
@@ -81,10 +86,16 @@ def lib() -> Optional[ctypes.CDLL]:
         if _tried:
             return _lib
         _tried = True
-        if not _build():
+        # Source checkout: (re)build from the checked-in sources; wheel
+        # install: use the .so setup.py compiled into the package.
+        if _build():
+            so = _SO_PATH
+        elif os.path.exists(_PACKAGED_SO):
+            so = _PACKAGED_SO
+        else:
             return None
         try:
-            L = ctypes.CDLL(_SO_PATH)
+            L = ctypes.CDLL(so)
         except OSError:
             return None
         u8p = ctypes.POINTER(ctypes.c_uint8)

@@ -132,6 +132,9 @@ class Wallet:
             if corrupt:
                 sig = _flip(sig, 40)
             tx.vin[n_in].witness = [sig]
+        # Construct-then-sign mutates the tx: any memoized id/serialization
+        # captured before signing would be stale (core/tx.py contract).
+        tx.invalidate_caches()
 
 
 def _flip(b: bytes, i: int) -> bytes:
@@ -248,9 +251,8 @@ def build_block(
         idx = witness_commitment_index(block)
         spk = coinbase.vout[idx].script_pubkey
         coinbase.vout[idx] = TxOut(0, spk[:6] + commit)
-        # Coinbase mutated after caching: rebuild identity caches.
-        coinbase._txid = None
-        coinbase._wtxid = None
+        # Coinbase mutated after caching: drop ids AND serializations.
+        coinbase.invalidate_caches()
     header.merkle_root = block_merkle_root(block)[0]
     while not check_proof_of_work(block.hash, bits, REGTEST_POW_LIMIT):
         header.nonce += 1

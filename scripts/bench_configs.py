@@ -207,7 +207,11 @@ def main() -> None:
         out[f"{label}_cached_replay_per_sec"] = round(cached, 1)
 
     print("config 5: block replay", file=sys.stderr)
-    secs, n_inputs, n_txs = bench_block_replay(verifier)
+    # Same tuning as scripts/bench_block.py: one dispatch for the whole
+    # block (the per-dispatch link round-trip costs more than padding),
+    # pad ladder capped at 2048-steps so ~5.6k checks ride a 6144 shape.
+    block_verifier = TpuSecpVerifier(min_batch=512, chunk=8192, pad_step=2048)
+    secs, n_inputs, n_txs = bench_block_replay(block_verifier)
     out["block_replay_ms"] = round(secs * 1000, 1)
     out["block_replay_inputs"] = n_inputs
     out["block_replay_txs"] = n_txs

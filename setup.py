@@ -1,0 +1,76 @@
+"""Build hook: compile the native host core into the wheel.
+
+The reference's build layer (`build.rs:36-96`) feature-detects the
+toolchain and compiles its native consensus sources at install time; the
+TPU framework mirrors that here. `python -m build` / `pip install .`
+compiles `native/nat.cpp` into `bitcoinconsensus_tpu/_native/libnat.so`
+so an installed package carries the C++ core without the source tree.
+
+Feature detection (the `check_uint128_t.c` / endianness-probe analogue,
+`build.rs:7-27`): the core requires a little-endian target with
+`unsigned __int128` (any x86-64/aarch64 g++/clang). The probe below
+compiles a one-liner first; when it fails — or no compiler exists — the
+wheel ships WITHOUT the native core and every path falls back to the
+pure-Python engine (`native_bridge.available()` -> False), which is
+consensus-exact, just slower. The runtime loader also rebuilds from the
+checked-in sources on demand in a source checkout (`native_bridge._build`).
+"""
+
+import os
+import shutil
+import subprocess
+import tempfile
+
+from setuptools import setup
+from setuptools.command.build_py import build_py
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+PROBE = (
+    "int main(){unsigned __int128 x=1;"
+    "const unsigned char e[4]={1,0,0,0};const int i=1;"
+    "return (x>>64)==0 && *(const char*)&i==e[0] ? 0 : 1;}"
+)
+
+
+def _cxx():
+    return os.environ.get("CXX") or shutil.which("g++") or shutil.which("clang++")
+
+
+def _probe(cxx: str) -> bool:
+    """build.rs-style target probe: __int128 + little-endian."""
+    with tempfile.TemporaryDirectory() as td:
+        src = os.path.join(td, "probe.cpp")
+        out = os.path.join(td, "probe")
+        with open(src, "w") as f:
+            f.write(PROBE)
+        try:
+            subprocess.run([cxx, src, "-o", out], check=True,
+                           capture_output=True, timeout=60)
+            return subprocess.run([out], timeout=10).returncode == 0
+        except Exception:
+            return False
+
+
+class BuildWithNative(build_py):
+    def run(self):
+        super().run()
+        cxx = _cxx()
+        if not cxx or not _probe(cxx):
+            print("native core: toolchain probe failed, shipping pure-Python")
+            return
+        dest_dir = os.path.join(self.build_lib, "bitcoinconsensus_tpu", "_native")
+        os.makedirs(dest_dir, exist_ok=True)
+        try:
+            subprocess.run(
+                [cxx, "-O2", "-std=c++17", "-fPIC", "-shared",
+                 os.path.join(HERE, "native", "nat.cpp"),
+                 "-o", os.path.join(dest_dir, "libnat.so")],
+                check=True, capture_output=True, timeout=300,
+            )
+            print("native core: built libnat.so into package")
+        except subprocess.CalledProcessError as e:
+            print("native core: build failed, shipping pure-Python:\n"
+                  + e.stderr.decode(errors="replace")[-2000:])
+
+
+setup(cmdclass={"build_py": BuildWithNative})
