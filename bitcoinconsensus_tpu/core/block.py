@@ -164,6 +164,7 @@ def merkle_root_device(hashes: List[bytes]) -> Tuple[bytes, bool]:
     BITCOINCONSENSUS_TPU_DEVICE_MERKLE=1 selects it.
     """
     import numpy as np
+    import jax
     import jax.numpy as jnp
 
     from ..ops.sha256 import sha256d_fixed
@@ -187,7 +188,10 @@ def merkle_root_device(hashes: List[bytes]) -> Tuple[bytes, bool]:
             level = jnp.concatenate([level, level[-1:]], axis=0)
             n += 1
         level = sha256d_fixed(level.reshape(n // 2, 64))
-    return bytes(np.asarray(level[0])), bool(np.asarray(mutated))
+    # ONE readback for root + flag (a second blocking fetch would double
+    # the link-latency cost this path exists to amortize).
+    root_np, mut_np = jax.device_get((level[0], mutated))
+    return bytes(root_np), bool(mut_np)
 
 
 def block_merkle_root(block: Block) -> Tuple[bytes, bool]:

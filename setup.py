@@ -23,6 +23,7 @@ import tempfile
 
 from setuptools import setup
 from setuptools.command.build_py import build_py
+from setuptools.dist import Distribution
 
 HERE = os.path.dirname(os.path.abspath(__file__))
 PROBE = (
@@ -71,6 +72,17 @@ class BuildWithNative(build_py):
         except subprocess.CalledProcessError as e:
             print("native core: build failed, shipping pure-Python:\n"
                   + e.stderr.decode(errors="replace")[-2000:])
+        except Exception as e:  # timeout, missing compiler mid-run, ...
+            print(f"native core: build failed ({e!r}), shipping pure-Python")
 
 
-setup(cmdclass={"build_py": BuildWithNative})
+class BinaryDistribution(Distribution):
+    """The bundled libnat.so is architecture-specific: force a
+    platform-tagged wheel (a py3-none-any wheel would be cached and
+    installed cross-arch, silently losing the native core there)."""
+
+    def has_ext_modules(self):
+        return True
+
+
+setup(cmdclass={"build_py": BuildWithNative}, distclass=BinaryDistribution)
