@@ -60,7 +60,7 @@ def _build() -> bool:
         subprocess.run(
             [
                 os.environ.get("CXX", "g++"),
-                "-O2",
+                "-O3",
                 "-std=c++17",
                 "-fPIC",
                 "-shared",

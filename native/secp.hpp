@@ -142,19 +142,8 @@ inline Fe fe_neg(const Fe& a) {
     return r;
 }
 
-// Full 256x256 -> 512 product, then fold 2^256 ≡ C twice + tail.
-inline Fe fe_mul(const Fe& a, const Fe& b) {
-    u64 t[8] = {0};
-    for (int i = 0; i < 4; i++) {
-        u128 c = 0;
-        for (int j = 0; j < 4; j++) {
-            c += (u128)a.n.v[i] * b.n.v[j] + t[i + j];
-            t[i + j] = (u64)c;
-            c >>= 64;
-        }
-        t[i + 4] = (u64)c;
-    }
-    // fold hi (t[4..7]) * C into lo
+// Fold a full 512-bit product (t[0..7]) with 2^256 ≡ C twice + tail.
+inline Fe fe_reduce_512(const u64 t[8]) {
     u64 lo[5] = {t[0], t[1], t[2], t[3], 0};
     u128 c = 0;
     for (int i = 0; i < 4; i++) {
@@ -191,7 +180,68 @@ inline Fe fe_mul(const Fe& a, const Fe& b) {
     return fe_from_u256(r);
 }
 
-inline Fe fe_sqr(const Fe& a) { return fe_mul(a, a); }
+// Full 256x256 -> 512 product, then fold.
+inline Fe fe_mul(const Fe& a, const Fe& b) {
+    u64 t[8] = {0};
+    for (int i = 0; i < 4; i++) {
+        u128 c = 0;
+        for (int j = 0; j < 4; j++) {
+            c += (u128)a.n.v[i] * b.n.v[j] + t[i + j];
+            t[i + j] = (u64)c;
+            c >>= 64;
+        }
+        t[i + 4] = (u64)c;
+    }
+    return fe_reduce_512(t);
+}
+
+// Dedicated squaring: 10 partial products instead of 16 (the doubling
+// formulas are squaring-heavy, ~35% of the ecmult field ops).
+inline Fe fe_sqr(const Fe& a) {
+    const u64 a0 = a.n.v[0], a1 = a.n.v[1], a2 = a.n.v[2], a3 = a.n.v[3];
+    u64 t[8];
+    u64 c0 = 0, c1 = 0, c2 = 0;
+    // column accumulator: (c2:c1:c0) += product, twice for cross terms
+    auto muladd = [&](u64 x, u64 y) {
+        u128 p = (u128)x * y;
+        u64 pl = (u64)p, ph = (u64)(p >> 64);
+        c0 += pl;
+        ph += (c0 < pl) ? 1 : 0;  // pl carry (ph < 2^64-1 before inc)
+        c1 += ph;
+        c2 += (c1 < ph) ? 1 : 0;
+    };
+    auto extract = [&](u64* out) {
+        *out = c0;
+        c0 = c1;
+        c1 = c2;
+        c2 = 0;
+    };
+    muladd(a0, a0);
+    extract(&t[0]);
+    muladd(a0, a1);
+    muladd(a0, a1);
+    extract(&t[1]);
+    muladd(a0, a2);
+    muladd(a0, a2);
+    muladd(a1, a1);
+    extract(&t[2]);
+    muladd(a0, a3);
+    muladd(a0, a3);
+    muladd(a1, a2);
+    muladd(a1, a2);
+    extract(&t[3]);
+    muladd(a1, a3);
+    muladd(a1, a3);
+    muladd(a2, a2);
+    extract(&t[4]);
+    muladd(a2, a3);
+    muladd(a2, a3);
+    extract(&t[5]);
+    muladd(a3, a3);
+    extract(&t[6]);
+    t[7] = c0;
+    return fe_reduce_512(t);
+}
 
 inline Fe fe_mul_small(const Fe& a, u64 k) {
     u128 c = 0;
@@ -534,7 +584,7 @@ inline const Ge* G_TABLE() {
         Gej g2 = gej_double(g);
         Gej cur = g;
         for (int i = 0; i < GTAB; i++) {
-            Fe x, y;
+            Fe x = {}, y = {};  // always written (cur is never infinity)
             gej_to_affine(cur, &x, &y);
             table[i].x = x;
             table[i].y = y;
@@ -596,8 +646,19 @@ inline int wnaf(const Sc& a, int w, int* out) {
     return len;
 }
 
-// R = a*G + b*P (either scalar may be zero; P affine, assumed on curve).
-inline Gej ecmult(const Sc& a, const Sc& b, const Ge& P) {
+// GLV scalar decomposition (defined with the GLV constants further
+// down; declared here for ecmult).
+struct GlvSplit {
+    u64 a1[2];  // |k1| < 2^128, little-endian
+    u64 a2[2];
+    int neg1, neg2;
+    bool ok;
+};
+inline GlvSplit split_lambda(const Sc& k);
+
+// R = a*G + b*P, plain Strauss over the full 256-bit scalars. Kept as
+// the (unreachable-in-practice) fallback for a failed GLV split.
+inline Gej ecmult_full(const Sc& a, const Sc& b, const Ge& P) {
     int wa[260], wb[260];
     int la = sc_is_zero(a) ? 0 : wnaf(a, 7, wa);
     int lb = sc_is_zero(b) ? 0 : wnaf(b, 5, wb);
@@ -623,6 +684,122 @@ inline Gej ecmult(const Sc& a, const Sc& b, const Ge& P) {
         if (i < lb && wb[i]) {
             int d = wb[i];
             Gej t = ptab[(d > 0 ? d : -d) / 2];
+            if (d < 0) t.y = fe_neg(t.y);
+            r = gej_add(r, t);
+        }
+    }
+    return r;
+}
+
+// GLV endomorphism: lambda*(x, y) = (BETA*x, y); beta^3 = 1 mod p.
+// Same (lambda, beta) pairing as crypto/glv.py / ops/curve.py.
+inline const Fe& GLV_BETA() {
+    static const Fe b = [] {
+        static const u8 bb[32] = {
+            0x7a, 0xe9, 0x6a, 0x2b, 0x65, 0x7c, 0x07, 0x10,
+            0x6e, 0x64, 0x47, 0x9e, 0xac, 0x34, 0x34, 0xe9,
+            0x9c, 0xf0, 0x49, 0x75, 0x12, 0xf5, 0x89, 0x95,
+            0xc1, 0x39, 0x6c, 0x28, 0x71, 0x95, 0x01, 0xee};
+        return fe_from_be(bb);
+    }();
+    return b;
+}
+
+// lambda * (odd multiples of G): the G table with beta-transformed x.
+inline const Ge* BETA_G_TABLE() {
+    static Ge table[GTAB];
+    static bool init = [] {
+        const Ge* g = G_TABLE();
+        for (int i = 0; i < GTAB; i++) {
+            table[i].x = fe_mul(g[i].x, GLV_BETA());
+            table[i].y = g[i].y;
+            table[i].infinity = false;
+        }
+        return true;
+    }();
+    (void)init;
+    return table;
+}
+
+// R = a*G + b*P via a 4-stream GLV Strauss: each scalar splits into two
+// signed <=128-bit halves (k = k1 + lambda*k2), halving the shared
+// doublings from ~257 to ~129 — the same endomorphism the pallas kernel
+// and the reference's ecmult_impl.h use. Digit signs fold the halves'
+// signs; the lambda streams read beta-transformed tables.
+inline Gej ecmult(const Sc& a, const Sc& b, const Ge& P) {
+    bool use_a = !sc_is_zero(a), use_b = !sc_is_zero(b);
+    GlvSplit sa, sb;
+    if (use_a) {
+        sa = split_lambda(a);
+        if (!sa.ok) return ecmult_full(a, b, P);
+    }
+    if (use_b) {
+        sb = split_lambda(b);
+        if (!sb.ok) return ecmult_full(a, b, P);
+    }
+    int w1[132], w2[132], w3[132], w4[132];
+    int l1 = 0, l2 = 0, l3 = 0, l4 = 0;
+    Sc h;
+    h.n = {{0, 0, 0, 0}};
+    if (use_a) {
+        h.n.v[0] = sa.a1[0];
+        h.n.v[1] = sa.a1[1];
+        l1 = sc_is_zero(h) ? 0 : wnaf(h, 7, w1);
+        h.n.v[0] = sa.a2[0];
+        h.n.v[1] = sa.a2[1];
+        l2 = sc_is_zero(h) ? 0 : wnaf(h, 7, w2);
+    }
+    if (use_b) {
+        h.n.v[0] = sb.a1[0];
+        h.n.v[1] = sb.a1[1];
+        l3 = sc_is_zero(h) ? 0 : wnaf(h, 5, w3);
+        h.n.v[0] = sb.a2[0];
+        h.n.v[1] = sb.a2[1];
+        l4 = sc_is_zero(h) ? 0 : wnaf(h, 5, w4);
+    }
+    // odd multiples {1,3,...,15} of P and lambda*P (x scaled by beta)
+    Gej ptab[8], bptab[8];
+    if (l3 | l4) {
+        Gej pj = gej_from_ge(P);
+        Gej p2 = gej_double(pj);
+        ptab[0] = pj;
+        for (int i = 1; i < 8; i++) ptab[i] = gej_add(ptab[i - 1], p2);
+        for (int i = 0; i < 8; i++) {
+            bptab[i].x = fe_mul(ptab[i].x, GLV_BETA());
+            bptab[i].y = ptab[i].y;
+            bptab[i].z = ptab[i].z;
+        }
+    }
+    const Ge* gtab = G_TABLE();
+    const Ge* bgtab = BETA_G_TABLE();
+    int len = l1;
+    if (l2 > len) len = l2;
+    if (l3 > len) len = l3;
+    if (l4 > len) len = l4;
+    Gej r = gej_infinity();
+    for (int i = len - 1; i >= 0; i--) {
+        r = gej_double(r);
+        if (i < l1 && w1[i]) {
+            int d = sa.neg1 ? -w1[i] : w1[i];
+            Ge t = gtab[(d > 0 ? d : -d) / 2];
+            if (d < 0) t.y = fe_neg(t.y);
+            r = gej_add_ge(r, t);
+        }
+        if (i < l2 && w2[i]) {
+            int d = sa.neg2 ? -w2[i] : w2[i];
+            Ge t = bgtab[(d > 0 ? d : -d) / 2];
+            if (d < 0) t.y = fe_neg(t.y);
+            r = gej_add_ge(r, t);
+        }
+        if (i < l3 && w3[i]) {
+            int d = sb.neg1 ? -w3[i] : w3[i];
+            Gej t = ptab[(d > 0 ? d : -d) / 2];
+            if (d < 0) t.y = fe_neg(t.y);
+            r = gej_add(r, t);
+        }
+        if (i < l4 && w4[i]) {
+            int d = sb.neg2 ? -w4[i] : w4[i];
+            Gej t = bptab[(d > 0 ? d : -d) / 2];
             if (d < 0) t.y = fe_neg(t.y);
             r = gej_add(r, t);
         }
@@ -789,11 +966,23 @@ inline bool verify_ecdsa(const u8* pub, size_t publen, const u8* sig,
     Sc u1 = sc_mul(m, sinv);
     Sc u2 = sc_mul(r, sinv);
     Gej R = ecmult(u1, u2, P);
-    Fe x, y;
-    if (!gej_to_affine(R, &x, &y)) return false;
-    // accept iff x mod n == r  (x < p; either x == r or x == r + n)
-    Sc xr = sc_from_u256(x.n);
-    return u256_cmp(xr.n, r.n) == 0;
+    if (gej_is_infinity(R)) return false;
+    // accept iff R.x_affine mod n == r, compared in Jacobian space to
+    // avoid the field inversion (ecdsa_impl.h:241-273 z^2 trick):
+    // x_affine == c  <=>  x_jacobian == c * z^2, for c in {r, r + n}
+    // (r + n only when it is still a valid x coordinate, < p).
+    Fe z2 = fe_sqr(R.z);
+    Fe rfe;
+    rfe.n = r.n;  // r < n < p
+    if (fe_eq(R.x, fe_mul(rfe, z2))) return true;
+    U256 rn;
+    u64 carry = u256_add(rn, r.n, ORDER_N());
+    if (!carry && u256_cmp(rn, FIELD_P()) < 0) {
+        Fe rn_fe;
+        rn_fe.n = rn;
+        return fe_eq(R.x, fe_mul(rn_fe, z2));
+    }
+    return false;
 }
 
 inline const TagMidstate& BIP340_CHALLENGE() {
@@ -950,13 +1139,6 @@ inline void glv_round_div(const u64 c[2], const U256& k, U256* q_out) {
     q_out->v[2] = q[2];
     q_out->v[3] = q[3];
 }
-
-struct GlvSplit {
-    u64 a1[2];  // |k1| < 2^128, little-endian
-    u64 a2[2];
-    int neg1, neg2;
-    bool ok;
-};
 
 inline GlvSplit split_lambda(const Sc& k) {
     GlvSplit out;

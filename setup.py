@@ -63,7 +63,7 @@ class BuildWithNative(build_py):
         os.makedirs(dest_dir, exist_ok=True)
         try:
             subprocess.run(
-                [cxx, "-O2", "-std=c++17", "-fPIC", "-shared",
+                [cxx, "-O3", "-std=c++17", "-fPIC", "-shared",
                  os.path.join(HERE, "native", "nat.cpp"),
                  "-o", os.path.join(dest_dir, "libnat.so")],
                 check=True, capture_output=True, timeout=300,
@@ -77,12 +77,15 @@ class BuildWithNative(build_py):
 
 
 class BinaryDistribution(Distribution):
-    """The bundled libnat.so is architecture-specific: force a
-    platform-tagged wheel (a py3-none-any wheel would be cached and
-    installed cross-arch, silently losing the native core there)."""
+    """The bundled libnat.so is architecture-specific: platform-tag the
+    wheel whenever the toolchain probe says the native core will be built
+    (a py3-none-any wheel would be cached and installed cross-arch,
+    silently losing the native core there). When the probe fails the
+    build ships pure-Python, and the wheel stays portable-tagged."""
 
     def has_ext_modules(self):
-        return True
+        cxx = _cxx()
+        return bool(cxx and _probe(cxx))
 
 
 setup(cmdclass={"build_py": BuildWithNative}, distclass=BinaryDistribution)
