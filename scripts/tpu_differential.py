@@ -76,14 +76,15 @@ def build_adversarial_checks(n: int, seed: int):
             checks.append(
                 SigCheck("tweak", (qx, qpar ^ 1, px, t.to_bytes(32, "big")))
             )
-        else:  # structurally broken blobs (host-parse rejects)
+        else:  # structurally broken blobs (host-parse rejects) — drawn
+            # from the seeded rng so a divergence stays reproducible
             kind = rng.choice(["ecdsa", "schnorr"])
             if kind == "ecdsa":
-                pub = bytes([rng.choice([0x05, 0x02])]) + os.urandom(32)
-                checks.append(SigCheck("ecdsa", (pub, os.urandom(70), msg)))
+                pub = bytes([rng.choice([0x05, 0x02])]) + rng.randbytes(32)
+                checks.append(SigCheck("ecdsa", (pub, rng.randbytes(70), msg)))
             else:
                 checks.append(
-                    SigCheck("schnorr", (os.urandom(31), os.urandom(64), msg))
+                    SigCheck("schnorr", (rng.randbytes(31), rng.randbytes(64), msg))
                 )
     return checks
 

@@ -37,8 +37,14 @@ def _cxx():
     return os.environ.get("CXX") or shutil.which("g++") or shutil.which("clang++")
 
 
+import functools
+
+
+@functools.lru_cache(maxsize=None)
 def _probe(cxx: str) -> bool:
-    """build.rs-style target probe: __int128 + little-endian."""
+    """build.rs-style target probe: __int128 + little-endian. Memoized —
+    setuptools queries has_ext_modules() several times per build and each
+    probe compiles AND runs a binary."""
     with tempfile.TemporaryDirectory() as td:
         src = os.path.join(td, "probe.cpp")
         out = os.path.join(td, "probe")

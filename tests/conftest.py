@@ -23,17 +23,28 @@ import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 
-# Persistent compile cache (same dir the backend configures): the windowed
-# verify kernel is the dominant compile; caching it across test processes
-# keeps suite runtime sane.
-jax.config.update(
-    "jax_compilation_cache_dir",
-    os.environ.get(
-        "BITCOINCONSENSUS_TPU_CACHE",
-        os.path.expanduser("~/.cache/bitcoinconsensus_tpu_xla"),
-    ),
-)
-jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+# Persistent compile cache (same dir the backend configures). NOTE on a
+# hard-won stability story: jaxlib intermittently SEGFAULTS on its
+# LARGEST compiles late in a long-lived pytest process — observed inside
+# backend_compile_and_load AND in the persistent-cache read/write paths,
+# with this cache on and off, with the native core on and off; the
+# identical compiles in a clean process always pass. The suite therefore
+# runs its two big-compile families (interpret-mode pallas equality, the
+# 8-device shard_map mesh programs) in fresh subprocesses
+# (tests/pallas_equality_check.py, tests/mesh_checks.py); the compiles
+# that remain in-process are small. Set BITCOINCONSENSUS_TPU_TEST_CACHE=0
+# to disable the cache when debugging a suspected cache-layer crash.
+if os.environ.get("BITCOINCONSENSUS_TPU_TEST_CACHE", "") in ("0", "off"):
+    jax.config.update("jax_enable_compilation_cache", False)
+else:
+    jax.config.update(
+        "jax_compilation_cache_dir",
+        os.environ.get(
+            "BITCOINCONSENSUS_TPU_CACHE",
+            os.path.expanduser("~/.cache/bitcoinconsensus_tpu_xla"),
+        ),
+    )
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 

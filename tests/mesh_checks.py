@@ -1,0 +1,133 @@
+"""Standalone multi-chip sharding checks, run in a FRESH process.
+
+Same rationale as pallas_equality_check.py: the 8-device shard_map
+programs are among the largest compiles in the suite, and XLA:CPU
+intermittently segfaults compiling them late in a long-lived pytest
+process (observed inside backend_compile_and_load and in the
+compilation-cache read/write paths, with the persistent cache on AND
+off, with the native core on AND off — jaxlib-internal; the identical
+compile in a clean process always passes). test_parallel.py runs each
+check here in its own interpreter; the subprocess uses the persistent
+compile cache, so repeat runs are fast.
+
+Usage: python tests/mesh_checks.py {dryrun|sharded|np2|hostreject}
+Exit code 0 = the assertions passed.
+"""
+
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import jax  # noqa: E402
+
+# The env var alone is not enough: accelerator plugins (axon) override it
+# at import time — the explicit config.update is load-bearing (same as
+# tests/conftest.py).
+jax.config.update("jax_platforms", "cpu")
+
+import hashlib  # noqa: E402
+
+import numpy as np  # noqa: E402
+
+
+def check_dryrun() -> None:
+    import __graft_entry__ as g
+
+    g.dryrun_multichip(8)
+
+
+def check_sharded() -> None:
+    """Sharded == unsharded, incl. failing lanes and the psum verdict."""
+    from bitcoinconsensus_tpu.crypto import secp_host as H
+    from bitcoinconsensus_tpu.crypto.jax_backend import SigCheck, TpuSecpVerifier
+    from bitcoinconsensus_tpu.parallel.mesh import ShardedSecpVerifier, make_mesh
+
+    checks = []
+    for i in range(10):
+        sk = (i * 7919 + 3) % (H.N - 1) + 1
+        msg = hashlib.sha256(b"shard-%d" % i).digest()
+        if i % 2:
+            xpk, _ = H.xonly_pubkey_create(sk)
+            sig = H.sign_schnorr(sk, msg)
+            if i == 5:
+                sig = sig[:8] + bytes([sig[8] ^ 1]) + sig[9:]
+            checks.append(SigCheck("schnorr", (xpk, sig, msg)))
+        else:
+            pub = H.pubkey_create(sk)
+            sig = H.sign_ecdsa(sk, msg)
+            if i == 4:
+                msg = hashlib.sha256(b"other").digest()
+            checks.append(SigCheck("ecdsa", (pub, sig, msg)))
+
+    plain = TpuSecpVerifier().verify_checks(checks)
+    sharded = ShardedSecpVerifier(make_mesh(8))
+    res, all_ok = sharded.verify_checks_with_verdict(checks)
+    assert np.array_equal(plain, res)
+    assert not all_ok  # lanes 4 and 5 are corrupted
+    assert list(np.nonzero(~res)[0]) == [4, 5]
+
+    good = [c for i, c in enumerate(checks) if i not in (4, 5)]
+    res2, ok2 = sharded.verify_checks_with_verdict(good)
+    assert res2.all() and ok2  # collective verdict from the psum step
+
+
+def check_np2() -> None:
+    """A 6-device mesh must not hang (ADVICE r1 medium) and must agree."""
+    from bitcoinconsensus_tpu.crypto import secp_host as H
+    from bitcoinconsensus_tpu.crypto.jax_backend import SigCheck, TpuSecpVerifier
+    from bitcoinconsensus_tpu.parallel.mesh import ShardedSecpVerifier, make_mesh
+
+    checks = []
+    for i in range(5):
+        sk = (i * 104729 + 11) % (H.N - 1) + 1
+        msg = hashlib.sha256(b"np2-%d" % i).digest()
+        checks.append(
+            SigCheck("ecdsa", (H.pubkey_create(sk), H.sign_ecdsa(sk, msg), msg))
+        )
+
+    sharded = ShardedSecpVerifier(make_mesh(6))
+    assert sharded._min_batch % 6 == 0
+    res, all_ok = sharded.verify_checks_with_verdict(checks)
+    assert res.all() and all_ok
+    plain = TpuSecpVerifier().verify_checks(checks)
+    assert np.array_equal(plain, res)
+
+
+def check_hostreject() -> None:
+    """A lane that fails host-side structural parsing (never dispatched)
+    must still flip the block verdict to False."""
+    from bitcoinconsensus_tpu.crypto import secp_host as H
+    from bitcoinconsensus_tpu.crypto.jax_backend import SigCheck
+    from bitcoinconsensus_tpu.parallel.mesh import ShardedSecpVerifier, make_mesh
+
+    sk = 12345
+    msg = hashlib.sha256(b"hr").digest()
+    checks = [
+        SigCheck("ecdsa", (H.pubkey_create(sk), H.sign_ecdsa(sk, msg), msg)),
+        SigCheck("ecdsa", (b"\x02" + b"\x00" * 31, b"junk-not-der", msg)),
+    ]
+    res, all_ok = ShardedSecpVerifier(make_mesh(8)).verify_checks_with_verdict(checks)
+    assert list(res) == [True, False]
+    assert not all_ok
+
+
+CHECKS = {
+    "dryrun": check_dryrun,
+    "sharded": check_sharded,
+    "np2": check_np2,
+    "hostreject": check_hostreject,
+}
+
+if __name__ == "__main__":
+    name = sys.argv[1]
+    CHECKS[name]()
+    print(f"mesh check '{name}': PASS")

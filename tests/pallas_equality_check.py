@@ -21,6 +21,13 @@ os.environ["JAX_PLATFORMS"] = "cpu"
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
+import jax  # noqa: E402
+
+# The env var alone is not enough: accelerator plugins (axon) override it
+# at import time — the explicit config.update is load-bearing (same as
+# tests/conftest.py).
+jax.config.update("jax_platforms", "cpu")
+
 import numpy as np  # noqa: E402
 
 

@@ -1,93 +1,43 @@
-"""Multi-chip sharding tests on the 8-device virtual CPU mesh (conftest)."""
+"""Multi-chip sharding tests — each runs in a FRESH subprocess.
 
-import numpy as np
+The 8-device shard_map programs are among the suite's largest compiles
+and XLA:CPU intermittently segfaults compiling them late in a long-lived
+pytest process (see tests/mesh_checks.py for the full evidence trail);
+the identical compiles in a clean process always pass, and the
+subprocesses warm the persistent compile cache so repeats are fast.
+"""
 
-from conftest import *  # noqa: F401,F403 (sets XLA_FLAGS before jax import)
+import os
+import subprocess
+import sys
+
+from conftest import *  # noqa: F401,F403 (env setup)
+
+_HELPER = os.path.join(os.path.dirname(os.path.abspath(__file__)), "mesh_checks.py")
+
+
+def _run_check(name: str, timeout: int = 1800) -> None:
+    proc = subprocess.run(
+        [sys.executable, _HELPER, name],
+        capture_output=True, text=True, timeout=timeout,
+    )
+    assert proc.returncode == 0, (
+        f"mesh check '{name}' failed (rc={proc.returncode})\n"
+        f"stdout:\n{proc.stdout}\nstderr:\n{proc.stderr[-4000:]}"
+    )
 
 
 def test_dryrun_multichip():
-    import sys
-    import os
-
-    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
-    import __graft_entry__ as g
-
-    g.dryrun_multichip(8)
+    _run_check("dryrun")
 
 
 def test_sharded_matches_unsharded():
-    import hashlib
-
-    from bitcoinconsensus_tpu.crypto import secp_host as H
-    from bitcoinconsensus_tpu.crypto.jax_backend import SigCheck, TpuSecpVerifier
-    from bitcoinconsensus_tpu.parallel.mesh import ShardedSecpVerifier, make_mesh
-
-    checks = []
-    for i in range(10):
-        sk = (i * 7919 + 3) % (H.N - 1) + 1
-        msg = hashlib.sha256(b"shard-%d" % i).digest()
-        if i % 2:
-            xpk, _ = H.xonly_pubkey_create(sk)
-            sig = H.sign_schnorr(sk, msg)
-            if i == 5:
-                sig = sig[:8] + bytes([sig[8] ^ 1]) + sig[9:]
-            checks.append(SigCheck("schnorr", (xpk, sig, msg)))
-        else:
-            pub = H.pubkey_create(sk)
-            sig = H.sign_ecdsa(sk, msg)
-            if i == 4:
-                msg = hashlib.sha256(b"other").digest()
-            checks.append(SigCheck("ecdsa", (pub, sig, msg)))
-
-    plain = TpuSecpVerifier().verify_checks(checks)
-    sharded = ShardedSecpVerifier(make_mesh(8))
-    res, all_ok = sharded.verify_checks_with_verdict(checks)
-    assert np.array_equal(plain, res)
-    assert not all_ok  # lanes 4 and 5 are corrupted
-    assert list(np.nonzero(~res)[0]) == [4, 5]
-
-    good = [c for i, c in enumerate(checks) if i not in (4, 5)]
-    res2, ok2 = sharded.verify_checks_with_verdict(good)
-    assert res2.all() and ok2  # collective verdict from the psum step
+    _run_check("sharded")
 
 
 def test_sharded_non_power_of_two_mesh():
-    """A 6-device mesh must not hang (ADVICE r1 medium) and must agree."""
-    import hashlib
-
-    from bitcoinconsensus_tpu.crypto import secp_host as H
-    from bitcoinconsensus_tpu.crypto.jax_backend import SigCheck, TpuSecpVerifier
-    from bitcoinconsensus_tpu.parallel.mesh import ShardedSecpVerifier, make_mesh
-
-    checks = []
-    for i in range(5):
-        sk = (i * 104729 + 11) % (H.N - 1) + 1
-        msg = hashlib.sha256(b"np2-%d" % i).digest()
-        checks.append(SigCheck("ecdsa", (H.pubkey_create(sk), H.sign_ecdsa(sk, msg), msg)))
-
-    sharded = ShardedSecpVerifier(make_mesh(6))
-    assert sharded._min_batch % 6 == 0
-    res, all_ok = sharded.verify_checks_with_verdict(checks)
-    assert res.all() and all_ok
-    plain = TpuSecpVerifier().verify_checks(checks)
-    assert np.array_equal(plain, res)
+    _run_check("np2")
 
 
 def test_sharded_verdict_counts_host_rejected_lane():
-    """A lane that fails host-side structural parsing (never dispatched)
-    must still flip the block verdict to False."""
-    import hashlib
-
-    from bitcoinconsensus_tpu.crypto import secp_host as H
-    from bitcoinconsensus_tpu.crypto.jax_backend import SigCheck
-    from bitcoinconsensus_tpu.parallel.mesh import ShardedSecpVerifier, make_mesh
-
-    sk = 12345
-    msg = hashlib.sha256(b"hr").digest()
-    checks = [
-        SigCheck("ecdsa", (H.pubkey_create(sk), H.sign_ecdsa(sk, msg), msg)),
-        SigCheck("ecdsa", (b"\x02" + b"\x00" * 31, b"junk-not-der", msg)),
-    ]
-    res, all_ok = ShardedSecpVerifier(make_mesh(8)).verify_checks_with_verdict(checks)
-    assert list(res) == [True, False]
-    assert not all_ok
+    _run_check("hostreject")
