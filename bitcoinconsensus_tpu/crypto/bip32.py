@@ -121,3 +121,6 @@ class ExtPubKey:
 
     def __eq__(self, other) -> bool:
         return isinstance(other, ExtPubKey) and self.encode() == other.encode()
+
+    def __hash__(self) -> int:
+        return hash(self.encode())

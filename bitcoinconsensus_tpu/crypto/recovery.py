@@ -40,8 +40,10 @@ def recover_compact(msg32: bytes, sig65: bytes) -> Optional[bytes]:
     if len(msg32) != 32 or len(sig65) != COMPACT_SIGNATURE_SIZE:
         return None
     header = sig65[0]
-    if header < 27 or header > 34:
-        return None  # (27+recid)+4*comp spans 27..34 inclusive
+    # RecoverCompact masks ANY header byte (pubkey.cpp:211-213): recid and
+    # the compression bit are taken mod 8 with C int wraparound, which
+    # Python's & on a negative int reproduces exactly (e.g. header 26 ->
+    # recid 3 compressed; header 35 -> recid 0 uncompressed).
     recid = (header - 27) & 3
     compressed = ((header - 27) & 4) != 0
     r = int.from_bytes(sig65[1:33], "big")

@@ -15,6 +15,8 @@
 #include "eval.hpp"
 #include "secp.hpp"
 
+#include <cstdio>
+#include <cstdlib>
 #include <cstring>
 
 using namespace nat;
@@ -191,11 +193,11 @@ void nat_prep_lanes(const u8* blob, const i64* offs, const i32* kinds, i32 n,
                     i32* neg1, i32* neg2, i32* valid) {
     // Pass 1: parse everything; collect ECDSA (r, s, m) for the batched
     // inversion (jax_backend._batch_inv_mod_n shape: one Fermat total).
-    Lane* lanes = new Lane[n];
-    i32* ecdsa_idx = new i32[n];
-    Sc* ecdsa_r = new Sc[n];
-    Sc* ecdsa_s = new Sc[n];
-    Sc* ecdsa_m = new Sc[n];
+    std::vector<Lane> lanes((size_t)n);
+    std::vector<i32> ecdsa_idx((size_t)n);
+    std::vector<Sc> ecdsa_r((size_t)n);
+    std::vector<Sc> ecdsa_s((size_t)n);
+    std::vector<Sc> ecdsa_m((size_t)n);
     i32 n_ecdsa = 0;
 
     for (i32 i = 0; i < n; i++) {
@@ -268,7 +270,7 @@ void nat_prep_lanes(const u8* blob, const i64* offs, const i32* kinds, i32 n,
     // Batched modular inverse of the ECDSA s values (Montgomery trick:
     // one Fermat chain total).
     if (n_ecdsa) {
-        Sc* prefix = new Sc[n_ecdsa];
+        std::vector<Sc> prefix((size_t)n_ecdsa);
         Sc acc;
         acc.n = {{1, 0, 0, 0}};
         for (i32 j = 0; j < n_ecdsa; j++) {
@@ -283,7 +285,6 @@ void nat_prep_lanes(const u8* blob, const i64* offs, const i32* kinds, i32 n,
             ln.a = sc_mul(ecdsa_m[j], sinv);      // u1
             set_b(ln, sc_mul(ecdsa_r[j], sinv));  // u2
         }
-        delete[] prefix;
     }
 
     // Pack (jax_backend._pack_lanes layout).
@@ -306,12 +307,6 @@ void nat_prep_lanes(const u8* blob, const i64* offs, const i32* kinds, i32 n,
         neg2[i] = ln.neg2;
         valid[i] = ln.valid ? 1 : 0;
     }
-
-    delete[] lanes;
-    delete[] ecdsa_idx;
-    delete[] ecdsa_r;
-    delete[] ecdsa_s;
-    delete[] ecdsa_m;
 }
 
 // ---------------------------------------------------------------------------
@@ -399,6 +394,11 @@ void nat_digest_checks(const u8* salt, i64 salt_len, i32 n, const i32* kinds,
         Sha256 h;
         h.write(salt, (size_t)salt_len);
         int kind = kinds[i] & 0xff;
+        if (kind > KIND_TWEAK) {
+            // An unsynchronized kind table must fail loudly, not read OOB.
+            std::fprintf(stderr, "nat_digest_checks: bad kind %d\n", kind);
+            std::abort();
+        }
         auto part = [&h](const u8* p, size_t len) {
             u8 lb[4] = {u8(len), u8(len >> 8), u8(len >> 16), u8(len >> 24)};
             h.write(lb, 4);

@@ -60,8 +60,6 @@ def test_recover_rejects_malformed():
     sig = sign_compact(sk, msg)
     assert recover_compact(msg, sig[:64]) is None  # short
     assert recover_compact(msg[:31], sig) is None  # short msg
-    assert recover_compact(msg, bytes([26]) + sig[1:]) is None  # bad header
-    assert recover_compact(msg, bytes([35]) + sig[1:]) is None
     n_b = H.N.to_bytes(32, "big")
     assert recover_compact(msg, sig[:1] + n_b + sig[33:]) is None  # r >= n
     assert recover_compact(msg, sig[:33] + n_b) is None  # s >= n
@@ -72,6 +70,27 @@ def test_recover_rejects_malformed():
     # any real r with the bit set fails the range check
     hdr = bytes([sig[0] + 2])
     assert recover_compact(msg, hdr + sig[1:]) is None
+
+
+def test_recover_noncanonical_headers_masked_like_reference():
+    # CPubKey::RecoverCompact masks ANY first byte: recid=(b-27)&3,
+    # compressed=((b-27)&4)!=0 with C int wraparound (pubkey.cpp:211-213).
+    # header 35 aliases header 27 (recid 0, uncompressed=... (35-27)=8,
+    # 8&3=0, 8&4=0 -> same as header 27); header 26 -> (26-27)=-1,
+    # (-1)&3=3, (-1)&4=4 -> recid 3 compressed.
+    sk = _sk("rec/mask")
+    msg = hashlib.sha256(b"mask").digest()
+    sig = sign_compact(sk, msg, compressed=False)
+    # header+8 leaves (h-27)&3 and (h-27)&4 unchanged but lands outside
+    # the canonical 27..34 window, so it must alias the canonical header
+    # exactly (the old range check would have returned None here).
+    aliased = recover_compact(msg, bytes([sig[0] + 8]) + sig[1:])
+    assert aliased is not None
+    assert aliased == recover_compact(msg, sig)
+    # header 26 -> C wraparound: (-1)&3 = 3, (-1)&4 = 4 (recid 3,
+    # compressed). recid&2 requires r < p - n which never holds for real
+    # signatures, so recovery fails via the range check, not the header.
+    assert recover_compact(msg, bytes([26]) + sig[1:]) is None
 
 
 # ---------------------------------------------------------------------------
@@ -105,6 +124,8 @@ def test_encode_decode_roundtrip():
     master = ExtPubKey.decode(_V2_MASTER_PUB)
     assert len(master.encode()) == BIP32_EXTKEY_SIZE
     assert ExtPubKey.decode(master.encode()) == master
+    # __hash__ is consistent with __eq__ so keys work in sets/dicts
+    assert len({master, ExtPubKey.decode(master.encode())}) == 1
 
 
 def test_derive_matches_scalar_identity():
