@@ -39,6 +39,18 @@ class ReferenceLib:
             ctypes.POINTER(ctypes.c_int),  # err
         ]
         self._verify = fn
+        fn2 = self._lib.bitcoinconsensus_verify_script
+        fn2.restype = ctypes.c_int
+        fn2.argtypes = [
+            ctypes.c_char_p,     # scriptPubKey
+            ctypes.c_uint,       # scriptPubKeyLen
+            ctypes.c_char_p,     # txTo
+            ctypes.c_uint,       # txToLen
+            ctypes.c_uint,       # nIn
+            ctypes.c_uint,       # flags
+            ctypes.POINTER(ctypes.c_int),  # err
+        ]
+        self._verify_no_amount = fn2
         ver = self._lib.bitcoinconsensus_version
         ver.restype = ctypes.c_uint
         self._version = ver
@@ -62,6 +74,27 @@ class ReferenceLib:
             spent_output_script,
             len(spent_output_script),
             amount,
+            spending_tx,
+            len(spending_tx),
+            input_index,
+            flags,
+            ctypes.byref(err),
+        )
+        return bool(ok), int(err.value)
+
+    def verify_no_amount(
+        self,
+        spent_output_script: bytes,
+        spending_tx: bytes,
+        input_index: int,
+        flags: int,
+    ) -> tuple:
+        """bitcoinconsensus_verify_script (bitcoinconsensus.h:67-69): the
+        amount-less legacy entry; WITNESS flag yields ERR_AMOUNT_REQUIRED."""
+        err = ctypes.c_int(0)
+        ok = self._verify_no_amount(
+            spent_output_script,
+            len(spent_output_script),
             spending_tx,
             len(spending_tx),
             input_index,

@@ -15,9 +15,11 @@
 #include "eval.hpp"
 #include "secp.hpp"
 
+#include <cstdint>
 #include <cstdio>
 #include <cstdlib>
 #include <cstring>
+#include <memory>
 
 using namespace nat;
 
@@ -138,11 +140,90 @@ i32 run_verify_input(Session* sess, NTx* tx, i32 n_in, i64 amount,
     return r.ok ? 1 : 0;
 }
 
+// --- Reference-compatible libbitcoinconsensus ABI -------------------------
+// Drop-in twins of the reference's three exported symbols
+// (bitcoinconsensus.h:67-75): same signatures, same error enum
+// (bitcoinconsensus.h:38-46), same check ordering (flags -> deserialize ->
+// index -> size, bitcoinconsensus.cpp:79-102). Consumers that link
+// libbitcoinconsensus can link libnat instead; tests/test_drop_in_abi.py
+// replays the differential corpus through BOTH .so's via one ctypes path.
+
+constexpr i32 BC_ERR_OK = 0;
+constexpr i32 BC_ERR_TX_INDEX = 1;
+constexpr i32 BC_ERR_TX_SIZE_MISMATCH = 2;
+constexpr i32 BC_ERR_TX_DESERIALIZE = 3;
+constexpr i32 BC_ERR_AMOUNT_REQUIRED = 4;
+constexpr i32 BC_ERR_INVALID_FLAGS = 5;
+
+// bitcoinconsensus_SCRIPT_FLAGS_VERIFY_ALL (bitcoinconsensus.h:49-61):
+// P2SH | DERSIG | NULLDUMMY | CHECKLOCKTIMEVERIFY | CHECKSEQUENCEVERIFY |
+// WITNESS. Anything outside is rejected (verify_flags,
+// bitcoinconsensus.cpp:74-77).
+constexpr u32 BC_FLAGS_VERIFY_ALL =
+    (1u << 0) | (1u << 2) | (1u << 4) | (1u << 9) | (1u << 10) | (1u << 11);
+
+inline int bc_set_error(i32* err, i32 code) {
+    if (err) *err = code;
+    return 0;
+}
+
+int bc_verify(const u8* spk, u32 spk_len, i64 amount, const u8* tx_to,
+              u32 tx_to_len, u32 n_in, u32 flags, i32* err) {
+    if (flags & ~BC_FLAGS_VERIFY_ALL)
+        return bc_set_error(err, BC_ERR_INVALID_FLAGS);
+    try {
+        std::unique_ptr<NTx> tx(tx_parse(tx_to, (size_t)tx_to_len));
+        if (n_in >= tx->vin.size()) return bc_set_error(err, BC_ERR_TX_INDEX);
+        // Exact re-serialization check (bitcoinconsensus.cpp:91-92):
+        // trailing bytes or non-canonical encodings that still parse must
+        // report TX_SIZE_MISMATCH.
+        if (tx->ser_size != (i64)tx_to_len)
+            return bc_set_error(err, BC_ERR_TX_SIZE_MISMATCH);
+        // Regardless of the verification result, the tx did not error
+        // (bitcoinconsensus.cpp:94-95).
+        bc_set_error(err, BC_ERR_OK);
+        precompute(*tx, nullptr);
+        i32 script_err, unknown;
+        return run_verify_input(nullptr, tx.get(), (i32)n_in, amount, spk,
+                                (i64)spk_len, (i32)flags, MODE_EXACT,
+                                &script_err, &unknown);
+    } catch (...) {
+        // Same fence as the reference shim (bitcoinconsensus.cpp:99-101).
+        return bc_set_error(err, BC_ERR_TX_DESERIALIZE);
+    }
+}
+
 }  // namespace
 
 extern "C" {
 
 int nat_version() { return 3; }
+
+// The three libbitcoinconsensus exports (bitcoinconsensus.h:67-75).
+
+int bitcoinconsensus_verify_script_with_amount(
+    const unsigned char* scriptPubKey, unsigned int scriptPubKeyLen,
+    int64_t amount, const unsigned char* txTo, unsigned int txToLen,
+    unsigned int nIn, unsigned int flags, i32* err) {
+    return bc_verify(scriptPubKey, scriptPubKeyLen, (i64)amount, txTo, txToLen,
+                     nIn, flags, err);
+}
+
+int bitcoinconsensus_verify_script(const unsigned char* scriptPubKey,
+                                   unsigned int scriptPubKeyLen,
+                                   const unsigned char* txTo,
+                                   unsigned int txToLen, unsigned int nIn,
+                                   unsigned int flags, i32* err) {
+    // The amount-less entry cannot serve BIP143 sighashes: WITNESS
+    // requires an amount (bitcoinconsensus.cpp:115-121).
+    if (flags & (1u << 11)) return bc_set_error(err, BC_ERR_AMOUNT_REQUIRED);
+    return bc_verify(scriptPubKey, scriptPubKeyLen, 0, txTo, txToLen, nIn,
+                     flags, err);
+}
+
+unsigned int bitcoinconsensus_version() {
+    return 1;  // BITCOINCONSENSUS_API_VER (bitcoinconsensus.h:36)
+}
 
 void nat_sha256(const u8* data, i64 len, u8* out32) {
     sha256(data, (size_t)len, out32);
