@@ -86,9 +86,14 @@ def lib() -> Optional[ctypes.CDLL]:
         if _tried:
             return _lib
         _tried = True
-        # Source checkout: (re)build from the checked-in sources; wheel
-        # install: use the .so setup.py compiled into the package.
-        if _build():
+        # Explicit override first (the sanitizer gate points this at
+        # libnat_san.so); then source checkout: (re)build from the
+        # checked-in sources; then wheel install: the .so setup.py
+        # compiled into the package.
+        override = os.environ.get("BITCOINCONSENSUS_NAT_SO", "")
+        if override:
+            so = override
+        elif _build():
             so = _SO_PATH
         elif os.path.exists(_PACKAGED_SO):
             so = _PACKAGED_SO

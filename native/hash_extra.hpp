@@ -86,7 +86,7 @@ struct Ripemd160 {
 
         for (size_t off = 0; off < full; off += 64) compress(data + off);
         size_t rem = len - full;
-        std::memcpy(tail, data + full, rem);
+        if (rem) std::memcpy(tail, data + full, rem);
         tail[rem] = 0x80;
         size_t tail_len = (rem + 8 < 64) ? 64 : 128;
         std::memset(tail + rem + 1, 0, tail_len - rem - 1 - 8);
@@ -139,7 +139,7 @@ inline void sha1(const u8* data, size_t len, u8 out[20]) {
     for (size_t off = 0; off < full; off += 64) compress(data + off);
     u8 tail[128];
     size_t rem = len - full;
-    std::memcpy(tail, data + full, rem);
+    if (rem) std::memcpy(tail, data + full, rem);
     tail[rem] = 0x80;
     size_t tail_len = (rem + 8 < 64) ? 64 : 128;
     std::memset(tail + rem + 1, 0, tail_len - rem - 1 - 8);

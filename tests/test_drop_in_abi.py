@@ -38,7 +38,9 @@ from test_vectors_json import (
 )
 
 REF = load_reference_lib()
-_NAT_SO = os.path.join(
+# Honor the same override native_bridge honors so the sanitizer gate
+# (contrib/sanitize.sh) routes this corpus through libnat_san.so.
+_NAT_SO = os.environ.get("BITCOINCONSENSUS_NAT_SO") or os.path.join(
     os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
     "native",
     "libnat.so",
