@@ -171,6 +171,24 @@ def lib() -> Optional[ctypes.CDLL]:
         L.nat_digest_streams.argtypes = [
             u8p, ctypes.c_int64, ctypes.c_int32, i64p, i64p, u8p, u8p,
         ]
+        # index-mode surface (session-resident uniq protocol)
+        L.nat_verify_inputs_idx.argtypes = [
+            vp, ctypes.POINTER(ctypes.c_void_p), i32p, i64p, u8p, i64p, i32p,
+            ctypes.c_int32, ctypes.c_int32, i32p, i32p, i32p, i64p,
+        ]
+        L.nat_session_uniq_count.argtypes = [vp]
+        L.nat_session_uniq_count.restype = ctypes.c_int32
+        L.nat_session_recidx_data.argtypes = [vp, i32p]
+        L.nat_session_uniq_lanes.argtypes = [
+            vp, i32p, ctypes.c_int32,
+            u8p, i32p, i32p, i32p, i32p, i32p, i32p,
+        ]
+        L.nat_session_uniq_digests.argtypes = [
+            vp, u8p, ctypes.c_int64, i32p, ctypes.c_int32, u8p,
+        ]
+        L.nat_session_publish_uniq.argtypes = [vp, i32p, ctypes.c_int32, i32p]
+        L.nat_session_uniq_host_verify.argtypes = [vp, ctypes.c_int32]
+        L.nat_session_uniq_host_verify.restype = ctypes.c_int32
         _lib = L
         return _lib
 
@@ -558,6 +576,121 @@ class NativeSession:
             flat[int(bounds[i]) : int(bounds[i + 1])] for i in range(n)
         ]
         return ok, err, unk, per_input
+
+    # --- Index-mode protocol (session-resident uniq checks) -----------
+    # The fast batch driver: check bytes stay in C++; Python sees int32
+    # indices into the session's deduped `uniq` list plus, on demand,
+    # packed kernel lanes / salted digests computed in place.
+
+    def verify_inputs_idx(
+        self,
+        ntxs: Sequence[NativeTx],
+        n_ins: Sequence[int],
+        amounts: Sequence[int],
+        script_pubkeys: Sequence[bytes],
+        flags: Sequence[int],
+        n_threads: int = 1,
+    ) -> Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
+        """Deferring interpretation of many inputs in ONE C call,
+        optionally sharded across `n_threads` worker threads (the
+        checkqueue.h:29-163 fan-out axis; the GIL is released for the
+        duration). Returns (ok, err, unknown, rec_idx, rec_bounds):
+        input i's oracle misses are uniq indices
+        rec_idx[rec_bounds[i]:rec_bounds[i+1]]."""
+        L = lib()
+        n = len(ntxs)
+        if n == 0:
+            z32 = np.zeros(0, np.int32)
+            return z32, z32, z32, z32, np.zeros(1, np.int64)
+        tx_ptrs = (ctypes.c_void_p * n)(*[t._ptr for t in ntxs])
+        nin_a = np.asarray(n_ins, dtype=np.int32)
+        amt_a = np.asarray(amounts, dtype=np.int64)
+        flg_a = np.asarray(flags, dtype=np.int32)
+        spk_offs = np.zeros(n + 1, dtype=np.int64)
+        for i, spk in enumerate(script_pubkeys):
+            spk_offs[i + 1] = spk_offs[i] + len(spk)
+        blob_b = b"".join(script_pubkeys)
+        blob = (
+            np.frombuffer(blob_b, dtype=np.uint8)
+            if blob_b
+            else np.zeros(1, np.uint8)
+        )
+        ok = np.zeros(n, dtype=np.int32)
+        err = np.zeros(n, dtype=np.int32)
+        unk = np.zeros(n, dtype=np.int32)
+        bounds = np.zeros(n + 1, dtype=np.int64)
+        L.nat_verify_inputs_idx(
+            self._ptr, tx_ptrs, _i32p(nin_a),
+            amt_a.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)), _u8p(blob),
+            spk_offs.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            _i32p(flg_a), n, int(n_threads), _i32p(ok), _i32p(err),
+            _i32p(unk),
+            bounds.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        )
+        n_idx = int(bounds[n])
+        rec_idx = np.zeros(max(n_idx, 1), dtype=np.int32)
+        if n_idx:
+            L.nat_session_recidx_data(self._ptr, _i32p(rec_idx))
+        return ok, err, unk, rec_idx[:n_idx], bounds
+
+    def uniq_count(self) -> int:
+        return int(lib().nat_session_uniq_count(self._ptr))
+
+    def uniq_lanes(self, idxs: np.ndarray, size: int):
+        """Packed kernel lanes for the uniq entries `idxs`, padded to
+        `size` — the session-resident twin of prep_pack."""
+        L = lib()
+        n = len(idxs)
+        assert size >= n
+        idx_a = np.ascontiguousarray(idxs, dtype=np.int32)
+        fields = np.zeros((size, 4, 32), dtype=np.uint8)
+        want_odd = np.zeros(size, dtype=np.int32)
+        parity = np.full(size, -1, dtype=np.int32)
+        has_t2 = np.zeros(size, dtype=np.int32)
+        neg1 = np.zeros(size, dtype=np.int32)
+        neg2 = np.zeros(size, dtype=np.int32)
+        valid_i = np.zeros(size, dtype=np.int32)
+        if n:
+            L.nat_session_uniq_lanes(
+                self._ptr, _i32p(idx_a), n, _u8p(fields), _i32p(want_odd),
+                _i32p(parity), _i32p(has_t2), _i32p(neg1), _i32p(neg2),
+                _i32p(valid_i),
+            )
+        return fields, want_odd, parity, has_t2, neg1, neg2, valid_i != 0
+
+    def uniq_digests(self, salt: bytes, idxs: np.ndarray) -> np.ndarray:
+        """(n, 32) uint8 salted cache-key digests for uniq entries
+        `idxs`, computed in place (no check bytes cross the bridge)."""
+        L = lib()
+        n = len(idxs)
+        out = np.zeros((max(n, 1), 32), dtype=np.uint8)
+        if n:
+            idx_a = np.ascontiguousarray(idxs, dtype=np.int32)
+            salt_a = (
+                np.frombuffer(salt, dtype=np.uint8)
+                if salt
+                else np.zeros(1, np.uint8)
+            )
+            L.nat_session_uniq_digests(
+                self._ptr, _u8p(salt_a), len(salt), _i32p(idx_a), n, _u8p(out)
+            )
+        return out[:n]
+
+    def publish_uniq(self, idxs: np.ndarray, results: np.ndarray) -> None:
+        """Publish verdicts for uniq entries `idxs` into the native
+        oracle (known map) without round-tripping check bytes."""
+        L = lib()
+        n = len(idxs)
+        if n == 0:
+            return
+        idx_a = np.ascontiguousarray(idxs, dtype=np.int32)
+        res_a = np.ascontiguousarray(results, dtype=np.int32)
+        L.nat_session_publish_uniq(self._ptr, _i32p(idx_a), n, _i32p(res_a))
+
+    def uniq_host_verify(self, idx: int) -> bool:
+        """Exact native verdict for one uniq entry (exceptional-lane
+        fixup)."""
+        return bool(lib().nat_session_uniq_host_verify(self._ptr, int(idx)))
 
     def verify_input(
         self,

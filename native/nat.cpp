@@ -20,6 +20,7 @@
 #include <cstdlib>
 #include <cstring>
 #include <memory>
+#include <thread>
 
 using namespace nat;
 
@@ -258,22 +259,67 @@ int nat_tweak_add_check(const u8* tweaked32, i32 parity, const u8* internal32,
     return tweak_add_check(tweaked32, parity, internal32, tweak32) ? 1 : 0;
 }
 
-// Batch lane prep. Inputs:
-//   blob/offs: check i's parts are blob[offs[3i]..offs[3i+1]),
-//     blob[offs[3i+1]..offs[3i+2]), blob[offs[3i+2]..offs[3i+3]).
+// One check's parts, independent of where the bytes live (wire blob from
+// Python or a session-resident Record) — the shared input shape of the
+// lane-prep and digest cores.
+struct PartsView {
+    int kind;    // 0 ecdsa, 1 schnorr, 2 tweak
+    int parity;  // tweak parity bit
+    const u8* p0;
+    i64 l0;
+    const u8* p1;
+    i64 l1;
+    const u8* p2;
+    i64 l2;
+};
+
+inline PartsView parts_from_wire(const u8* blob, const i64* offs,
+                                 const i32* kinds, i32 i) {
+    return PartsView{
+        kinds[i] & 0xff,          (kinds[i] >> 8) & 1,
+        blob + offs[3 * i],       offs[3 * i + 1] - offs[3 * i],
+        blob + offs[3 * i + 1],   offs[3 * i + 2] - offs[3 * i + 1],
+        blob + offs[3 * i + 2],   offs[3 * i + 3] - offs[3 * i + 2],
+    };
+}
+
+// Record/digest part order (ecdsa pubkey|sig|msg, schnorr pk32|sig64|msg,
+// tweak q32|internal32|tweak32 — the models/sigcache.py stream order).
+inline PartsView parts_from_record(const Record& r) {
+    return PartsView{
+        r.kind,          r.parity,
+        r.p0.data(),     (i64)r.p0.size(),
+        r.p1.data(),     (i64)r.p1.size(),
+        r.p2.data(),     (i64)r.p2.size(),
+    };
+}
+
+// Lane-prep part order: the prep core expects tweak checks as
+// internal32 | tweak32 | tweaked32 (the prep_pack wire permutation).
+inline PartsView parts_from_record_lanes(const Record& r) {
+    if (r.kind == KIND_TWEAK)
+        return PartsView{
+            r.kind,          r.parity,
+            r.p1.data(),     (i64)r.p1.size(),
+            r.p2.data(),     (i64)r.p2.size(),
+            r.p0.data(),     (i64)r.p0.size(),
+        };
+    return parts_from_record(r);
+}
+
+// Lane-prep core: parts -> packed kernel lanes. Parts per kind:
 //     ecdsa:   pubkey | sig_der | msg32
 //     schnorr: pk32   | sig64   | msg32
-//     tweak:   internal32 | tweak32 | tweaked32  (parity in kinds[i]>>8)
-//   kinds[i] & 0xff: 0 ecdsa, 1 schnorr, 2 tweak.
-//   n: number of checks.
+//     tweak:   internal32 | tweak32 | tweaked32
 // Outputs (caller-allocated, only the first n lanes are written):
 //   fields: n*128 bytes — per lane (a | b1 | b2 | px | t1) little-endian
 //   want_odd/parity/has_t2/neg1/neg2/valid: n x i32 each
-void nat_prep_lanes(const u8* blob, const i64* offs, const i32* kinds, i32 n,
-                    u8* fields, i32* want_odd, i32* parity, i32* has_t2,
-                    i32* neg1, i32* neg2, i32* valid) {
+void prep_lanes_impl(const std::vector<PartsView>& parts, u8* fields,
+                     i32* want_odd, i32* parity, i32* has_t2, i32* neg1,
+                     i32* neg2, i32* valid) {
     // Pass 1: parse everything; collect ECDSA (r, s, m) for the batched
     // inversion (jax_backend._batch_inv_mod_n shape: one Fermat total).
+    const i32 n = (i32)parts.size();
     std::vector<Lane> lanes((size_t)n);
     std::vector<i32> ecdsa_idx((size_t)n);
     std::vector<Sc> ecdsa_r((size_t)n);
@@ -284,13 +330,13 @@ void nat_prep_lanes(const u8* blob, const i64* offs, const i32* kinds, i32 n,
     for (i32 i = 0; i < n; i++) {
         Lane& ln = lanes[i];
         ln.px = GEN().x.n;  // invalid-lane default matches _Lane (G_X)
-        const u8* p0 = blob + offs[3 * i];
-        i64 l0 = offs[3 * i + 1] - offs[3 * i];
-        const u8* p1 = blob + offs[3 * i + 1];
-        i64 l1 = offs[3 * i + 2] - offs[3 * i + 1];
-        const u8* p2 = blob + offs[3 * i + 2];
-        i64 l2 = offs[3 * i + 3] - offs[3 * i + 2];
-        int kind = kinds[i] & 0xff;
+        const u8* p0 = parts[i].p0;
+        i64 l0 = parts[i].l0;
+        const u8* p1 = parts[i].p1;
+        i64 l1 = parts[i].l1;
+        const u8* p2 = parts[i].p2;
+        i64 l2 = parts[i].l2;
+        int kind = parts[i].kind;
         if (kind == KIND_ECDSA) {
             if (l2 != 32) continue;
             if (!host_parse_pubkey(ln, p0, l0)) continue;
@@ -343,7 +389,7 @@ void nat_prep_lanes(const u8* blob, const i64* offs, const i32* kinds, i32 n,
             one.n = {{1, 0, 0, 0}};
             set_b(ln, one);
             ln.t1 = u256_from_be(p2);  // raw: >= p can never match
-            ln.parity = (kinds[i] >> 8) & 1;
+            ln.parity = parts[i].parity;
             ln.valid = true;
         }
     }
@@ -388,6 +434,19 @@ void nat_prep_lanes(const u8* blob, const i64* offs, const i32* kinds, i32 n,
         neg2[i] = ln.neg2;
         valid[i] = ln.valid ? 1 : 0;
     }
+}
+
+// Wire-shape entry (Python packs blob/offs/kinds; kinds[i]&0xff is the
+// kind, bit 8 the tweak parity).
+void nat_prep_lanes(const u8* blob, const i64* offs, const i32* kinds, i32 n,
+                    u8* fields, i32* want_odd, i32* parity, i32* has_t2,
+                    i32* neg1, i32* neg2, i32* valid) {
+    std::vector<PartsView> parts;
+    parts.reserve((size_t)n);
+    for (i32 i = 0; i < n; i++)
+        parts.push_back(parts_from_wire(blob, offs, kinds, i));
+    prep_lanes_impl(parts, fields, want_odd, parity, has_t2, neg1, neg2,
+                    valid);
 }
 
 // ---------------------------------------------------------------------------
@@ -468,38 +527,38 @@ void nat_session_add_known_batch(void* s, i32 n, const i32* kinds,
 //   sha256(salt || [len(part) as 4-byte LE || part]...)
 // with parts = [kind-name, data...] and the tweak parity serialized as an
 // 8-byte signed little-endian int between q32 and internal32.
+// Digest core shared by the wire and session-resident entries.
+void digest_one(const u8* salt, i64 salt_len, const PartsView& pv, u8* out32) {
+    static const char* NAMES[3] = {"ecdsa", "schnorr", "tweak"};
+    Sha256 h;
+    h.write(salt, (size_t)salt_len);
+    if (pv.kind > KIND_TWEAK) {
+        // An unsynchronized kind table must fail loudly, not read OOB.
+        std::fprintf(stderr, "digest_one: bad kind %d\n", pv.kind);
+        std::abort();
+    }
+    auto part = [&h](const u8* p, size_t len) {
+        u8 lb[4] = {u8(len), u8(len >> 8), u8(len >> 16), u8(len >> 24)};
+        h.write(lb, 4);
+        h.write(p, len);
+    };
+    const char* name = NAMES[pv.kind];
+    part(reinterpret_cast<const u8*>(name), std::strlen(name));
+    part(pv.p0, (size_t)pv.l0);
+    if (pv.kind == KIND_TWEAK) {
+        u8 pb[8] = {u8(pv.parity & 1), 0, 0, 0, 0, 0, 0, 0};
+        part(pb, 8);
+    }
+    part(pv.p1, (size_t)pv.l1);
+    part(pv.p2, (size_t)pv.l2);
+    h.finalize(out32);
+}
+
 void nat_digest_checks(const u8* salt, i64 salt_len, i32 n, const i32* kinds,
                        const u8* blob, const i64* offs, u8* out) {
-    static const char* NAMES[3] = {"ecdsa", "schnorr", "tweak"};
-    for (i32 i = 0; i < n; i++) {
-        Sha256 h;
-        h.write(salt, (size_t)salt_len);
-        int kind = kinds[i] & 0xff;
-        if (kind > KIND_TWEAK) {
-            // An unsynchronized kind table must fail loudly, not read OOB.
-            std::fprintf(stderr, "nat_digest_checks: bad kind %d\n", kind);
-            std::abort();
-        }
-        auto part = [&h](const u8* p, size_t len) {
-            u8 lb[4] = {u8(len), u8(len >> 8), u8(len >> 16), u8(len >> 24)};
-            h.write(lb, 4);
-            h.write(p, len);
-        };
-        const char* name = NAMES[kind];
-        part(reinterpret_cast<const u8*>(name), std::strlen(name));
-        const u8* p0 = blob + offs[3 * i];
-        const u8* p1 = blob + offs[3 * i + 1];
-        const u8* p2 = blob + offs[3 * i + 2];
-        const u8* p3 = blob + offs[3 * i + 3];
-        part(p0, (size_t)(p1 - p0));
-        if (kind == KIND_TWEAK) {
-            u8 pb[8] = {u8((kinds[i] >> 8) & 1), 0, 0, 0, 0, 0, 0, 0};
-            part(pb, 8);
-        }
-        part(p1, (size_t)(p2 - p1));
-        part(p2, (size_t)(p3 - p2));
-        h.finalize(out + 32 * (size_t)i);
-    }
+    for (i32 i = 0; i < n; i++)
+        digest_one(salt, salt_len, parts_from_wire(blob, offs, kinds, i),
+                   out + 32 * (size_t)i);
 }
 
 // Generic batched salted digests over variable part lists (the script-
@@ -596,6 +655,157 @@ void nat_verify_inputs(void* s, void** txs, const i32* n_ins,
                                  &err[i], &unk[i]);
         rec_bounds[i + 1] = sess ? (i64)sess->records.size() : 0;
     }
+}
+
+// ---------------------------------------------------------------------------
+// Index-mode batch surface: the session keeps ONE deduped check list
+// (`uniq`) and every consumer — lane prep for the device kernel, salted
+// cache digests, verdict publication, exact host fallback — reads it in
+// place. Python sees only int32 indices; no check bytes ever cross the
+// bridge twice. This is the TPU-era CCheckQueue fan-out
+// (checkqueue.h:29-163): `n_threads` shards the per-input interpretation
+// across worker threads that share the session's oracle read-only and
+// merge their discovered checks serially (order-preserving, so lane
+// order is deterministic regardless of thread count).
+
+// Interpret inputs [lo, hi) against `sess` (which may be a worker
+// scratch whose `oracle` points at the shared session). Per-input
+// rec_idx bounds are recorded into local_bounds[lo..hi].
+static void run_idx_range(Session* sess, void** txs, const i32* n_ins,
+                          const i64* amounts, const u8* spk_blob,
+                          const i64* spk_offs, const i32* flags, i32 lo,
+                          i32 hi, i32* ok, i32* err, i32* unk,
+                          i64* local_bounds) {
+    for (i32 i = lo; i < hi; i++) {
+        ok[i] = run_verify_input(sess, static_cast<NTx*>(txs[i]), n_ins[i],
+                                 amounts[i], spk_blob + spk_offs[i],
+                                 spk_offs[i + 1] - spk_offs[i], flags[i],
+                                 MODE_DEFER, &err[i], &unk[i]);
+        local_bounds[i + 1] = (i64)sess->rec_idx.size();
+    }
+}
+
+void nat_verify_inputs_idx(void* s, void** txs, const i32* n_ins,
+                           const i64* amounts, const u8* spk_blob,
+                           const i64* spk_offs, const i32* flags, i32 n,
+                           i32 n_threads, i32* ok, i32* err, i32* unk,
+                           i64* rec_bounds) {
+    auto* sess = static_cast<Session*>(s);
+    sess->index_mode = true;
+    sess->rec_idx.clear();
+    rec_bounds[0] = 0;
+    if (n_threads < 2 || n < 2 * n_threads) {
+        // rec_idx was just cleared, so per-input bounds are global bounds.
+        run_idx_range(sess, txs, n_ins, amounts, spk_blob, spk_offs, flags, 0,
+                      n, ok, err, unk, rec_bounds);
+        return;
+    }
+    i32 T = n_threads;
+    std::vector<Session> scratch((size_t)T);
+    std::vector<std::vector<i64>> bounds((size_t)T);
+    std::vector<std::thread> workers;
+    workers.reserve((size_t)T);
+    for (i32 t = 0; t < T; t++) {
+        scratch[t].index_mode = true;
+        scratch[t].oracle = sess;
+        bounds[t].assign((size_t)n + 1, 0);
+        i32 lo = (i32)((i64)n * t / T);
+        i32 hi = (i32)((i64)n * (t + 1) / T);
+        workers.emplace_back([&, t, lo, hi] {
+            // The scratch session's rec_idx is empty at entry, so the
+            // worker's bounds slots [lo+1, hi] are relative to 0.
+            run_idx_range(&scratch[t], txs, n_ins, amounts, spk_blob,
+                          spk_offs, flags, lo, hi, ok, err, unk,
+                          bounds[t].data());
+        });
+    }
+    for (auto& w : workers) w.join();
+    // Serial merge in shard order: dedup each scratch's uniq into the
+    // shared session, remap its rec_idx entries, and lay down global
+    // rec_bounds — identical discovery order to a single-threaded run
+    // over the same shard sequence.
+    for (i32 t = 0; t < T; t++) {
+        Session& sc = scratch[t];
+        std::vector<i32> remap(sc.uniq.size());
+        for (size_t j = 0; j < sc.uniq.size(); j++) {
+            auto ins = sess->uniq_seen.try_emplace(std::move(sc.uniq_keys[j]),
+                                                   (i32)sess->uniq.size());
+            if (ins.second) {
+                sess->uniq.push_back(std::move(sc.uniq[j]));
+                sess->uniq_keys.push_back(ins.first->first);
+            }
+            remap[j] = ins.first->second;
+        }
+        i32 lo = (i32)((i64)n * t / T);
+        i32 hi = (i32)((i64)n * (t + 1) / T);
+        for (i32 i = lo; i < hi; i++) {
+            for (i64 j = bounds[t][(size_t)i]; j < bounds[t][(size_t)i + 1];
+                 j++)
+                sess->rec_idx.push_back(remap[(size_t)sc.rec_idx[(size_t)j]]);
+            rec_bounds[i + 1] = (i64)sess->rec_idx.size();
+        }
+    }
+}
+
+i32 nat_session_uniq_count(void* s) {
+    return (i32)static_cast<Session*>(s)->uniq.size();
+}
+
+void nat_session_recidx_data(void* s, i32* out) {
+    auto* sess = static_cast<Session*>(s);
+    std::memcpy(out, sess->rec_idx.data(), sess->rec_idx.size() * sizeof(i32));
+}
+
+// Kernel lanes for uniq[idxs[0..nidx)] — session-resident prep, no wire
+// blob. Output layout identical to nat_prep_lanes.
+void nat_session_uniq_lanes(void* s, const i32* idxs, i32 nidx, u8* fields,
+                            i32* want_odd, i32* parity, i32* has_t2,
+                            i32* neg1, i32* neg2, i32* valid) {
+    auto* sess = static_cast<Session*>(s);
+    std::vector<PartsView> parts;
+    parts.reserve((size_t)nidx);
+    for (i32 j = 0; j < nidx; j++)
+        parts.push_back(parts_from_record_lanes(sess->uniq[(size_t)idxs[j]]));
+    prep_lanes_impl(parts, fields, want_odd, parity, has_t2, neg1, neg2,
+                    valid);
+}
+
+// Salted cache-key digests for uniq[idxs[0..nidx)] (models/sigcache.py
+// key stream — same bytes nat_digest_checks produces for the wire shape).
+void nat_session_uniq_digests(void* s, const u8* salt, i64 salt_len,
+                              const i32* idxs, i32 nidx, u8* out) {
+    auto* sess = static_cast<Session*>(s);
+    for (i32 j = 0; j < nidx; j++)
+        digest_one(salt, salt_len,
+                   parts_from_record(sess->uniq[(size_t)idxs[j]]),
+                   out + 32 * (size_t)j);
+}
+
+// Publish device/cache verdicts for uniq[idxs[0..nidx)] into the oracle.
+void nat_session_publish_uniq(void* s, const i32* idxs, i32 nidx,
+                              const i32* results) {
+    auto* sess = static_cast<Session*>(s);
+    for (i32 j = 0; j < nidx; j++)
+        sess->known[sess->uniq_keys[(size_t)idxs[j]]] = results[j] != 0;
+}
+
+// Exact host verdict for one uniq entry (the exceptional-lane fixup path:
+// crafted scalar collisions the fast device adds defer — never honest
+// traffic).
+i32 nat_session_uniq_host_verify(void* s, i32 idx) {
+    auto* sess = static_cast<Session*>(s);
+    const Record& r = sess->uniq[(size_t)idx];
+    if (r.kind == KIND_ECDSA)
+        return verify_ecdsa(r.p0.data(), r.p0.size(), r.p1.data(),
+                            r.p1.size(), r.p2.data())
+                   ? 1
+                   : 0;
+    if (r.kind == KIND_SCHNORR)
+        return verify_schnorr(r.p0.data(), r.p1.data(), r.p2.data()) ? 1 : 0;
+    // tweak record order: q32 | internal32 | tweak32
+    return tweak_add_check(r.p0.data(), r.parity, r.p1.data(), r.p2.data())
+               ? 1
+               : 0;
 }
 
 }  // extern "C"
