@@ -103,6 +103,13 @@ def lib() -> Optional[ctypes.CDLL]:
             L = ctypes.CDLL(so)
         except OSError:
             return None
+        # ABI gate: a stale override/packaged .so with an older exported
+        # surface (e.g. the pre-v4 recidx_data signature) must not load —
+        # the typed prototypes below would mis-call it. Fall back to the
+        # pure-Python paths instead.
+        L.nat_version.restype = ctypes.c_int
+        if L.nat_version() < 4:
+            return None
         u8p = ctypes.POINTER(ctypes.c_uint8)
         i32p = ctypes.POINTER(ctypes.c_int32)
         i64p = ctypes.POINTER(ctypes.c_int64)
@@ -178,7 +185,8 @@ def lib() -> Optional[ctypes.CDLL]:
         ]
         L.nat_session_uniq_count.argtypes = [vp]
         L.nat_session_uniq_count.restype = ctypes.c_int32
-        L.nat_session_recidx_data.argtypes = [vp, i32p]
+        L.nat_session_recidx_data.argtypes = [vp, i32p, ctypes.c_int64]
+        L.nat_session_recidx_data.restype = ctypes.c_int64
         L.nat_session_uniq_lanes.argtypes = [
             vp, i32p, ctypes.c_int32,
             u8p, i32p, i32p, i32p, i32p, i32p, i32p,
@@ -630,7 +638,9 @@ class NativeSession:
         n_idx = int(bounds[n])
         rec_idx = np.zeros(max(n_idx, 1), dtype=np.int32)
         if n_idx:
-            L.nat_session_recidx_data(self._ptr, _i32p(rec_idx))
+            got = int(L.nat_session_recidx_data(self._ptr, _i32p(rec_idx), n_idx))
+            if got != n_idx:  # concurrent session mutation or ABI skew
+                raise RuntimeError(f"recidx_data short copy: {got} != {n_idx}")
         return ok, err, unk, rec_idx[:n_idx], bounds
 
     def uniq_count(self) -> int:

@@ -41,7 +41,7 @@ python - <<'EOF'
 import sys
 sys.path.insert(0, ".")
 from bitcoinconsensus_tpu import native_bridge as NB
-if not NB.available() or NB.lib().nat_version() < 3:
+if not NB.available() or NB.lib().nat_version() < 4:
     sys.exit("sanitize: libnat_san.so failed to load — gate would be vacuous")
 print("sanitize: sanitized library loaded, nat_version", NB.lib().nat_version())
 EOF
@@ -50,6 +50,7 @@ python -m pytest \
     tests/test_native.py \
     tests/test_native_interp.py \
     tests/test_native_batch.py \
+    tests/test_native_idx.py \
     tests/test_drop_in_abi.py \
     -q "$@"
 echo "sanitize: ASAN+UBSAN clean"

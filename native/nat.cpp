@@ -198,7 +198,8 @@ int bc_verify(const u8* spk, u32 spk_len, i64 amount, const u8* tx_to,
 
 extern "C" {
 
-int nat_version() { return 3; }
+// 4: nat_session_recidx_data grew a capacity argument + i64 return.
+int nat_version() { return 4; }
 
 // The three libbitcoinconsensus exports (bitcoinconsensus.h:67-75).
 
@@ -629,7 +630,15 @@ i32 nat_verify_input(void* s, void* txp, i32 n_in, i64 amount, const u8* spk,
                      i64 spk_len, i32 flags, i32 mode, i32* script_err,
                      i32* unknown) {
     auto* sess = static_cast<Session*>(s);
-    if (sess) sess->records.clear();
+    if (sess) {
+        // Symmetric with nat_verify_inputs_idx setting it true: a session
+        // that served the index protocol must not keep routing the legacy
+        // records path's oracle misses into uniq/rec_idx (the records
+        // drain would return 0 entries while unk > 0 and the driver would
+        // publish optimistic verdicts with the misses unresolved).
+        sess->index_mode = false;
+        sess->records.clear();
+    }
     return run_verify_input(sess, static_cast<NTx*>(txp), n_in, amount, spk,
                             spk_len, flags, mode, script_err, unknown);
 }
@@ -646,7 +655,10 @@ void nat_verify_inputs(void* s, void** txs, const i32* n_ins,
                        const i64* spk_offs, const i32* flags, i32 mode, i32 n,
                        i32* ok, i32* err, i32* unk, i64* rec_bounds) {
     auto* sess = static_cast<Session*>(s);
-    if (sess) sess->records.clear();
+    if (sess) {
+        sess->index_mode = false;  // see nat_verify_input's comment
+        sess->records.clear();
+    }
     rec_bounds[0] = 0;
     for (i32 i = 0; i < n; i++) {
         ok[i] = run_verify_input(sess, static_cast<NTx*>(txs[i]), n_ins[i],
@@ -751,9 +763,28 @@ i32 nat_session_uniq_count(void* s) {
     return (i32)static_cast<Session*>(s)->uniq.size();
 }
 
-void nat_session_recidx_data(void* s, i32* out) {
+// A stale or negative uniq index from the driver is an OOB read / heap
+// corruption; fail loudly instead (same pattern as digest_one's kind
+// guard).
+inline const Record& uniq_at(Session* sess, i32 idx) {
+    if (idx < 0 || (size_t)idx >= sess->uniq.size()) {
+        std::fprintf(stderr, "uniq_at: index %d out of range (uniq size %zu)\n",
+                     idx, sess->uniq.size());
+        std::abort();
+    }
+    return sess->uniq[(size_t)idx];
+}
+
+// `capacity` is the caller's buffer size (the rec_idx length observed at
+// verify time); ctypes releases the GIL during calls, so copying
+// rec_idx.size() entries unchecked would overflow the buffer if another
+// thread grew the session in between. Returns the count actually copied.
+i64 nat_session_recidx_data(void* s, i32* out, i64 capacity) {
     auto* sess = static_cast<Session*>(s);
-    std::memcpy(out, sess->rec_idx.data(), sess->rec_idx.size() * sizeof(i32));
+    i64 n = (i64)sess->rec_idx.size();
+    if (capacity < n) n = capacity;
+    if (n > 0) std::memcpy(out, sess->rec_idx.data(), (size_t)n * sizeof(i32));
+    return n;
 }
 
 // Kernel lanes for uniq[idxs[0..nidx)] — session-resident prep, no wire
@@ -765,7 +796,7 @@ void nat_session_uniq_lanes(void* s, const i32* idxs, i32 nidx, u8* fields,
     std::vector<PartsView> parts;
     parts.reserve((size_t)nidx);
     for (i32 j = 0; j < nidx; j++)
-        parts.push_back(parts_from_record_lanes(sess->uniq[(size_t)idxs[j]]));
+        parts.push_back(parts_from_record_lanes(uniq_at(sess, idxs[j])));
     prep_lanes_impl(parts, fields, want_odd, parity, has_t2, neg1, neg2,
                     valid);
 }
@@ -776,8 +807,7 @@ void nat_session_uniq_digests(void* s, const u8* salt, i64 salt_len,
                               const i32* idxs, i32 nidx, u8* out) {
     auto* sess = static_cast<Session*>(s);
     for (i32 j = 0; j < nidx; j++)
-        digest_one(salt, salt_len,
-                   parts_from_record(sess->uniq[(size_t)idxs[j]]),
+        digest_one(salt, salt_len, parts_from_record(uniq_at(sess, idxs[j])),
                    out + 32 * (size_t)j);
 }
 
@@ -785,8 +815,10 @@ void nat_session_uniq_digests(void* s, const u8* salt, i64 salt_len,
 void nat_session_publish_uniq(void* s, const i32* idxs, i32 nidx,
                               const i32* results) {
     auto* sess = static_cast<Session*>(s);
-    for (i32 j = 0; j < nidx; j++)
+    for (i32 j = 0; j < nidx; j++) {
+        uniq_at(sess, idxs[j]);  // bounds guard (uniq_keys is parallel)
         sess->known[sess->uniq_keys[(size_t)idxs[j]]] = results[j] != 0;
+    }
 }
 
 // Exact host verdict for one uniq entry (the exceptional-lane fixup path:
@@ -794,7 +826,7 @@ void nat_session_publish_uniq(void* s, const i32* idxs, i32 nidx,
 // traffic).
 i32 nat_session_uniq_host_verify(void* s, i32 idx) {
     auto* sess = static_cast<Session*>(s);
-    const Record& r = sess->uniq[(size_t)idx];
+    const Record& r = uniq_at(sess, idx);
     if (r.kind == KIND_ECDSA)
         return verify_ecdsa(r.p0.data(), r.p0.size(), r.p1.data(),
                             r.p1.size(), r.p2.data())

@@ -1,0 +1,197 @@
+"""Index-mode batch surface (nat_verify_inputs_idx + the uniq trio).
+
+The session-resident protocol must be behaviorally identical to the wire
+protocol it replaces (nat_verify_inputs + records drain + prep_pack +
+digest_checks + add_known_batch):
+
+- verdicts/errors/unknown-counts agree per input;
+- input i's rec_idx slice names exactly the checks the wire path drains
+  for input i (dedup aside);
+- uniq_lanes == prep_pack of the same records, byte for byte;
+- uniq_digests == SigCache keys of the same records;
+- publish_uniq answers oracle reads exactly like add_known_batch;
+- n_threads > 1 produces the SAME uniq order, rec_idx stream and
+  verdicts as single-threaded (the shard merge is order-preserving);
+- a session that served the index protocol can serve the wire protocol
+  afterwards (index_mode resets — the ADVICE r4 protocol-mixing trap).
+"""
+
+import hashlib
+
+import numpy as np
+import pytest
+
+from conftest import *  # noqa: F401,F403 (env setup)
+
+from bitcoinconsensus_tpu import native_bridge
+from bitcoinconsensus_tpu.core.flags import (
+    VERIFY_ALL_EXTENDED,
+    VERIFY_ALL_LIBCONSENSUS,
+)
+from bitcoinconsensus_tpu.models.sigcache import SigCache
+from bitcoinconsensus_tpu.utils.blockgen import build_spend_tx, make_funded_view
+
+pytestmark = pytest.mark.skipif(
+    not native_bridge.available(), reason="native core unavailable"
+)
+
+
+def _mixed_inputs(n=12, seed="idx", corrupt=()):
+    """n inputs cycling p2wpkh / p2tr / p2wsh-2of3 as one spend tx; returns
+    (ntxs, n_ins, amounts, spks, flags) ready for the batched calls."""
+    kinds = ("p2wpkh", "p2tr", "p2wsh_multisig")
+    _, funded = make_funded_view(n, kinds=kinds, seed=seed)
+    tx = build_spend_tx(funded, fee=900)
+    for i in corrupt:
+        w = list(tx.vin[i].witness)
+        j = 0 if len(w[0]) else 1
+        w[j] = w[j][:6] + bytes([w[j][6] ^ 1]) + w[j][7:]
+        tx.vin[i].witness = w
+    raw = tx.serialize()
+    spent = [(f.amount, f.wallet.spk) for f in funded]
+    ntx = native_bridge.NativeTx(raw)
+    ntx.set_spent_outputs(spent)
+    ntxs = [ntx] * n
+    n_ins = list(range(n))
+    amounts = [f.amount for f in funded]
+    spks = [f.wallet.spk for f in funded]
+    flags = [VERIFY_ALL_EXTENDED] * n
+    return ntxs, n_ins, amounts, spks, flags
+
+
+def _wire_reference(args):
+    """Run the same inputs through the wire protocol; returns
+    (ok, err, unk, per-input record lists, session)."""
+    sess = native_bridge.NativeSession()
+    ok, err, unk, recs = sess.verify_inputs(
+        *args, mode=native_bridge.NativeSession.MODE_DEFER
+    )
+    return ok, err, unk, recs, sess
+
+
+def test_idx_matches_wire_protocol():
+    args = _mixed_inputs()
+    w_ok, w_err, w_unk, w_recs, w_sess = _wire_reference(args)
+    w_spec = w_sess.take_spec()
+
+    sess = native_bridge.NativeSession()
+    ok, err, unk, rec_idx, bounds = sess.verify_inputs_idx(*args)
+    assert np.array_equal(ok, w_ok)
+    assert np.array_equal(err, w_err)
+    assert np.array_equal(unk, w_unk)
+
+    # Reconstruct per-input checks from uniq and compare to the wire drain.
+    U = sess.uniq_count()
+    all_idx = np.arange(U, dtype=np.int32)
+    dig = sess.uniq_digests(b"salt!", all_idx)
+    wire_digest = {}  # digest -> wire (kind, data)
+    flat_wire = [r for recs in w_recs for r in recs] + w_spec
+    wire_keys = native_bridge.digest_checks(b"salt!", flat_wire)
+    for k, r in zip(wire_keys, flat_wire):
+        wire_digest[k] = r
+    # every uniq entry is one of the wire-drained checks and vice versa
+    uniq_keys = [dig[i].tobytes() for i in range(U)]
+    assert set(uniq_keys) == set(wire_digest)
+
+    # per-input slices name the same checks in the same order
+    n = len(args[0])
+    for i in range(n):
+        mine = [uniq_keys[j] for j in rec_idx[int(bounds[i]) : int(bounds[i + 1])]]
+        theirs = native_bridge.digest_checks(b"salt!", w_recs[i])
+        assert mine == theirs, f"input {i}"
+
+    # lanes parity: uniq lanes == prep_pack of the same records
+    from bitcoinconsensus_tpu.crypto.jax_backend import SigCheck
+
+    checks = [SigCheck(k, d) for k, d in (wire_digest[k2] for k2 in uniq_keys)]
+    size = max(8, U)
+    ref = native_bridge.prep_pack(checks, size)
+    mine = sess.uniq_lanes(all_idx, size)
+    for a, b in zip(mine, ref):
+        assert np.array_equal(np.asarray(a), np.asarray(b))
+
+    # digests parity vs the sigcache key stream
+    cache = SigCache()
+    assert [
+        d.tobytes() for d in sess.uniq_digests(cache._salt, all_idx)
+    ] == cache.keys_for_checks(checks)
+
+
+def test_idx_threads_deterministic():
+    args = _mixed_inputs(n=16, seed="idx-t")
+    base = native_bridge.NativeSession()
+    ok0, err0, unk0, ri0, b0 = base.verify_inputs_idx(*args, n_threads=1)
+    d0 = [d.tobytes() for d in base.uniq_digests(b"s", np.arange(base.uniq_count(), dtype=np.int32))]
+    for T in (2, 4, 7):
+        s = native_bridge.NativeSession()
+        ok, err, unk, ri, b = s.verify_inputs_idx(*args, n_threads=T)
+        assert np.array_equal(ok, ok0) and np.array_equal(err, err0)
+        assert np.array_equal(unk, unk0)
+        assert np.array_equal(ri, ri0) and np.array_equal(b, b0)
+        d = [d2.tobytes() for d2 in s.uniq_digests(b"s", np.arange(s.uniq_count(), dtype=np.int32))]
+        assert d == d0
+
+
+def test_publish_uniq_matches_add_known():
+    args = _mixed_inputs(n=6, seed="idx-p", corrupt=(2,))
+    sess = native_bridge.NativeSession()
+    ok, err, unk, rec_idx, bounds = sess.verify_inputs_idx(*args)
+    U = sess.uniq_count()
+    # host-exact verdicts for every uniq entry, published back
+    verdicts = np.asarray(
+        [1 if sess.uniq_host_verify(i) else 0 for i in range(U)], dtype=np.int32
+    )
+    sess.publish_uniq(np.arange(U, dtype=np.int32), verdicts)
+    ok2, err2, unk2, ri2, b2 = sess.verify_inputs_idx(*args)
+    assert np.all(unk2 == 0)  # every oracle read now answered
+    # corrupt input fails, the rest pass — matches the exact mode verdicts
+    s_ex = native_bridge.NativeSession()
+    ok_ex, err_ex, _, _ = s_ex.verify_inputs(
+        *args, mode=native_bridge.NativeSession.MODE_EXACT
+    )
+    assert np.array_equal(ok2, ok_ex)
+    assert np.array_equal(err2, err_ex)
+    assert not ok2[2] and ok2[0] and ok2[1]
+
+
+def test_idx_then_wire_protocol_mixing():
+    """ADVICE r4: after an idx-mode call, the legacy wire path on the SAME
+    session must drain real records again (index_mode resets)."""
+    args = _mixed_inputs(n=3, seed="idx-mix")
+    sess = native_bridge.NativeSession()
+    sess.verify_inputs_idx(*args)
+    assert sess.uniq_count() > 0
+    ok, err, unk, recs = sess.verify_inputs(
+        *args, mode=native_bridge.NativeSession.MODE_DEFER
+    )
+    for i in range(3):
+        assert int(unk[i]) > 0
+        assert len(recs[i]) == int(unk[i])  # records drained, not dropped
+
+    # and single-input wire entry resets too
+    sess2 = native_bridge.NativeSession()
+    sess2.verify_inputs_idx(*args)
+    ok1, err1, unk1 = sess2.verify_input(
+        args[0][0], 0, args[2][0], args[3][0], args[4][0]
+    )
+    assert unk1 > 0 and len(sess2.take_records()) == unk1
+
+
+def test_recidx_capacity_clamp():
+    """nat_session_recidx_data copies at most `capacity` entries."""
+    import ctypes
+
+    args = _mixed_inputs(n=4, seed="idx-cap")
+    sess = native_bridge.NativeSession()
+    _, _, _, rec_idx, bounds = sess.verify_inputs_idx(*args)
+    n_idx = int(bounds[-1])
+    assert n_idx >= 2
+    L = native_bridge.lib()
+    buf = np.full(2, -1, dtype=np.int32)
+    got = int(
+        L.nat_session_recidx_data(
+            sess._ptr, buf.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)), 2
+        )
+    )
+    assert got == 2
+    assert np.array_equal(buf, rec_idx[:2])
