@@ -97,11 +97,19 @@ class BlockHeader:
 class Block:
     """Header + transactions (primitives/block.h:75-90)."""
 
-    __slots__ = ("header", "vtx")
+    __slots__ = ("header", "vtx", "_native")  # _native: cached NativeBlock
 
     def __init__(self, header: BlockHeader, vtx: List[Tx]):
         self.header = header
         self.vtx = vtx
+
+    def __getstate__(self):
+        # The cached native parse is a raw C++ handle — drop it from
+        # pickles/copies; models/validate.py re-parses on demand.
+        return (self.header, self.vtx)
+
+    def __setstate__(self, state):
+        self.header, self.vtx = state
 
     @classmethod
     def deserialize(cls, data: bytes) -> "Block":

@@ -499,6 +499,34 @@ class TpuSecpVerifier:
                     out[start : start + count] = np.asarray(res)[:count]
         return out
 
+    def pad(self, n: int) -> int:
+        """Public pad-ladder size for `n` lanes (the index-mode batch
+        driver packs lanes natively and needs the same padded shapes)."""
+        return self._pad(n)
+
+    @property
+    def chunk(self) -> int:
+        return self._chunk
+
+    def dispatch_lanes(self, args: Tuple, n: int):
+        """Async-dispatch one packed lane batch (the prep_pack 7-tuple,
+        already padded); returns an opaque pending handle for sync_lanes.
+        The index-mode driver's seam: lanes are prepped in the native
+        session (uniq_lanes) so no SigCheck objects exist on this side."""
+        with self.phases("dispatch"):
+            return self._run_kernel(args, n)
+
+    def sync_lanes(self, pending, n: int) -> Tuple[np.ndarray, Optional[np.ndarray]]:
+        """Materialize a dispatch_lanes result: (ok[:n], needs_host[:n] or
+        None). Lanes flagged needs_host hit an exceptional group-law case
+        (crafted scalar collisions); the caller must resolve them exactly
+        (nat_session_uniq_host_verify) — they report ok=False here."""
+        with self.phases("sync"):
+            if isinstance(pending, tuple):
+                ok, needs = pending
+                return np.asarray(ok)[:n], np.asarray(needs)[:n]
+            return np.asarray(pending)[:n], None
+
     def _host_check(self, chk: SigCheck) -> bool:
         """Host-exact resolution of one check (native core when present,
         pure-Python oracle otherwise)."""

@@ -30,8 +30,11 @@ Batch results are bit-identical to per-input `verify_with_flags` /
 
 from __future__ import annotations
 
+import os
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
 
 from ..api import ConsensusError, Error
 from ..core.flags import ALL_FLAG_BITS, LIBCONSENSUS_FLAGS, VERIFY_TAPROOT
@@ -273,6 +276,188 @@ def _prepare(
     return prep
 
 
+def _idx_threads() -> int:
+    """Interpretation fan-out width for the native index-mode path (the
+    checkqueue.h:29-163 axis; the C call releases the GIL). Overridable
+    via BITCOINCONSENSUS_TPU_THREADS; single-core hosts stay serial."""
+    env = os.environ.get("BITCOINCONSENSUS_TPU_THREADS", "")
+    if env:
+        return max(1, int(env))
+    return max(1, os.cpu_count() or 1)
+
+
+def _accept_mask(resolved: np.ndarray, rec_idx: np.ndarray, bounds,
+                 unk) -> np.ndarray:
+    """Per-input acceptance after a resolve round: input k's verdict is
+    exact when it had no oracle misses (unk == 0) or every miss resolved
+    TRUE (the optimistic assumption matched reality). Vectorized over the
+    rec_idx slices via one cumulative sum — the per-input Python loop this
+    replaces was ~10% of block-replay host time."""
+    unk = np.asarray(unk)
+    out = unk == 0
+    if len(rec_idx) and not out.all():
+        have = resolved[rec_idx].astype(np.int64)
+        b = np.asarray(bounds, dtype=np.int64)
+        cs = np.concatenate([np.zeros(1, np.int64), np.cumsum(have)])
+        out = out | ((cs[b[1:]] - cs[b[:-1]]) == (b[1:] - b[:-1]))
+    return out
+
+
+def _resolve_uniq(nsess, verifier, sig_cache, resolved: np.ndarray) -> np.ndarray:
+    """Resolve every uniq entry the session discovered since the last call
+    (entries [len(resolved), uniq_count)): salted sig-cache probe first
+    (success-only skip, script/sigcache.cpp:22-122), then packed kernel
+    lanes prepped IN the session (no check bytes cross the bridge) and one
+    pipelined device dispatch per chunk; exceptional lanes flagged by the
+    fast device adds resolve exactly via nat_session_uniq_host_verify.
+    Verdicts are published straight into the native oracle. Returns the
+    grown 0/1 verdict array aligned with uniq indices."""
+    U = nsess.uniq_count()
+    lo = len(resolved)
+    if U == lo:
+        return resolved
+    idxs = np.arange(lo, U, dtype=np.int32)
+    with verifier.phases("host_prep"):
+        digs = nsess.uniq_digests(sig_cache._salt, idxs)
+    raw = digs.tobytes()
+    keys = [raw[32 * j : 32 * j + 32] for j in range(U - lo)]
+    new = np.zeros(U - lo, dtype=bool)
+    if len(sig_cache) == 0:  # cold cache: every probe misses
+        miss: List[int] = list(range(U - lo))
+    else:
+        miss = []
+        for j, k in enumerate(keys):
+            if sig_cache.contains_key(k):
+                new[j] = True
+            else:
+                miss.append(j)
+    if miss:
+        chunk = verifier.chunk
+        pending = []
+        for s in range(0, len(miss), chunk):
+            sub = miss[s : s + chunk]
+            sub_idx = idxs[sub]
+            with verifier.phases("host_prep"):
+                lanes = nsess.uniq_lanes(sub_idx, verifier.pad(len(sub)))
+            pending.append((verifier.dispatch_lanes(lanes, len(sub)), sub_idx, sub))
+        for pend, sub_idx, sub in pending:
+            okv, needs = verifier.sync_lanes(pend, len(sub))
+            okv = np.array(okv, dtype=bool, copy=True)
+            if needs is not None and needs.any():
+                for t in np.nonzero(needs)[0]:
+                    r = nsess.uniq_host_verify(int(sub_idx[t]))
+                    okv[t] = r
+                    if not r:
+                        verifier._fixup_failed = True
+            new[np.asarray(sub)] = okv
+            for t in np.nonzero(okv)[0]:  # success-only, like the reference
+                sig_cache.add_key(keys[sub[int(t)]])
+    nsess.publish_uniq(idxs, new.astype(np.int32))
+    return np.concatenate([resolved, new])
+
+
+def run_idx_fixpoint(
+    nsess,
+    verifier: TpuSecpVerifier,
+    sig_cache: SigCache,
+    live: Sequence[int],
+    run_idx,
+    exact_fallback,
+    max_rounds: int = 24,  # > MAX_PUBKEYS_PER_MULTISIG cursor retries
+) -> Dict[int, Tuple[bool, int]]:
+    """The deferral fixpoint both index-mode drivers share (`_verify_batch_idx`
+    and models/validate.py `_connect_block_native` — ONE copy of the
+    consensus-critical loop): interpret pending inputs (`run_idx(pos) ->
+    (ok, err, unk, rec_idx, bounds)`), resolve every newly-discovered uniq
+    check (cache probe + device dispatch + publish), accept inputs whose
+    verdicts are exact (no misses, or every miss confirmed true), repeat;
+    inputs still pending at the round cap go through `exact_fallback(idx)
+    -> (ok, err_code)`. Returns {input: (ok, script_err_code)}."""
+    final: Dict[int, Tuple[bool, int]] = {}
+    resolved = np.zeros(0, dtype=bool)
+    pending = list(live)
+    for _round in range(max_rounds):
+        if not pending:
+            break
+        ok, err, unk, rec_idx, bounds = run_idx(pending)
+        resolved = _resolve_uniq(nsess, verifier, sig_cache, resolved)
+        # exact verdict (unk == 0), or optimistic with every guess
+        # confirmed true — equivalent to an exact pass
+        accept = _accept_mask(resolved, rec_idx, bounds, unk)
+        still: List[int] = []
+        for k, idx in enumerate(pending):
+            if accept[k]:
+                final[idx] = (bool(ok[k]), int(err[k]))
+            else:
+                still.append(idx)
+        pending = still
+    for idx in pending:  # round cap hit: exact host fallback
+        final[idx] = exact_fallback(idx)
+    return final
+
+
+def _verify_batch_idx(
+    items: Sequence[BatchItem],
+    preps: List[_Prepared],
+    nsess,
+    verifier: TpuSecpVerifier,
+    sig_cache: SigCache,
+    script_cache: ScriptExecutionCache,
+    script_keys: List[Optional[bytes]],
+) -> List[BatchResult]:
+    """Index-mode batch driver (the fast path of `verify_batch`).
+
+    Same three phases as the legacy wire driver — deferring
+    interpretation, one deduplicated device dispatch, oracle
+    re-interpretation to a fixpoint — but the session keeps the deduped
+    check list (`uniq`) in C++ and Python only ever moves int32 indices
+    and packed lane arrays (native/nat.cpp nat_verify_inputs_idx + the
+    uniq trio). Interpretation shards across `_idx_threads()` workers
+    (checkqueue.h:29-163 shape). Results are bit-identical to the wire
+    driver and the per-input API (tests/test_batch.py runs both paths)."""
+    live = [i for i, p in enumerate(preps) if p.result is None]
+    final: Dict[int, Tuple[bool, int]] = {}
+    if live:
+        n_threads = _idx_threads()
+
+        def run_idx(pos: List[int]):
+            with verifier.phases("interpret"):
+                return nsess.verify_inputs_idx(
+                    [preps[i].ntx for i in pos],
+                    [items[i].input_index for i in pos],
+                    [preps[i].amount for i in pos],
+                    [preps[i].script_pubkey for i in pos],
+                    [items[i].flags for i in pos],
+                    n_threads=n_threads,
+                )
+
+        def exact_fallback(idx: int) -> Tuple[bool, int]:
+            okx, err_code, _ = nsess.verify_input(
+                preps[idx].ntx, items[idx].input_index, preps[idx].amount,
+                preps[idx].script_pubkey, items[idx].flags,
+                mode=native_bridge.NativeSession.MODE_EXACT,
+            )
+            return okx, err_code
+
+        final = run_idx_fixpoint(
+            nsess, verifier, sig_cache, live, run_idx, exact_fallback
+        )
+
+    out: List[BatchResult] = []
+    for idx, prep in enumerate(preps):
+        if prep.result is not None:
+            out.append(prep.result)
+            continue
+        ok, err = final[idx]
+        if ok:
+            if script_keys[idx] is not None:
+                script_cache.add_key(script_keys[idx])
+            out.append(BatchResult.success())
+        else:
+            out.append(BatchResult(False, Error.ERR_SCRIPT, ScriptError(err)))
+    return out
+
+
 def verify_batch(
     items: Sequence[BatchItem],
     verifier: Optional[TpuSecpVerifier] = None,
@@ -345,6 +530,21 @@ def _verify_batch_impl(
         script_keys[idx] = key
         if script_cache.contains_key(key):
             preps[idx].result = BatchResult.success()
+
+    # Fast path: with the native core on, every prep either failed
+    # transport checks (result set) or holds a native tx handle — the
+    # whole batch runs the index-mode protocol (check bytes never cross
+    # the bridge; Python sees int32 uniq indices only).
+    # BITCOINCONSENSUS_TPU_IDX=0 forces the legacy wire driver (kept as
+    # the executable spec; tests run the corpus through both).
+    if (
+        use_native
+        and os.environ.get("BITCOINCONSENSUS_TPU_IDX", "") not in ("0", "off")
+        and all(p.result is not None or p.ntx is not None for p in preps)
+    ):
+        return _verify_batch_idx(
+            items, preps, nsess, verifier, sig_cache, script_cache, script_keys
+        )
 
     # Phase 1: optimistic interpretation, recording curve checks. Inputs
     # the native engine parsed run in ONE batched C call (native/eval.hpp,

@@ -22,6 +22,7 @@ from __future__ import annotations
 from dataclasses import dataclass
 from typing import Dict, List, Optional, Tuple
 
+from ..api import Error
 from ..core.block import (
     Block,
     MAX_BLOCK_SIGOPS_COST,
@@ -201,12 +202,195 @@ def connect_block(
     Cycle collection is paused for the duration (utils/gcpause.py; see
     verify_batch) — the accounting loops over thousands of inputs
     otherwise pay repeated full GC passes over the JAX heap.
+
+    With the native core on and a `NativeCoinsView`, the whole block
+    layer (codec, merkle, CheckBlock, witness commitment, accounting,
+    sigop costing, view update) runs in C++ and the script phase drives
+    the index-mode session directly — the production replay path
+    (`_connect_block_native`). Results are identical to the Python
+    pipeline (tests/test_native_block.py replays both).
     """
+    from .. import native_bridge
+
     with gc_paused():
+        if (
+            isinstance(coins, native_bridge.NativeCoinsView)
+            and native_bridge.available()
+        ):
+            return _connect_block_native(
+                block, coins, height, flags, verifier, check_pow,
+                check_scripts, enforce_witness_commitment, pow_limit,
+                sig_cache, script_cache,
+            )
         return _connect_block_impl(
             block, coins, height, flags, verifier, check_pow, check_scripts,
             enforce_witness_commitment, pow_limit, sig_cache, script_cache,
         )
+
+
+def _connect_block_native(
+    block, coins, height, flags, verifier, check_pow, check_scripts,
+    enforce_witness_commitment, pow_limit, sig_cache, script_cache,
+) -> ConnectResult:
+    """`connect_block` with the block layer in C++ (native/block.hpp) and
+    the script phase on the index-mode session protocol.
+
+    Phase map (validation.cpp:1946-2228): CheckBlock + witness commitment
+    + BIP30/maturity/value/sigop accounting + per-tx hash precompute all
+    happen in three C calls; the script phase interprets every input in
+    one nat_verify_inputs_idx call and resolves the deduped checks with
+    one device dispatch (models/batch.py's driver, shared helpers); the
+    view update is one C call. Verdicts and reject reasons are identical
+    to `_connect_block_impl` (tests/test_native_block.py)."""
+    import numpy as np
+
+    from .. import native_bridge
+    from .batch import _idx_threads
+
+    if flags is None:
+        flags = height_to_flags(height, extended=True)
+    if isinstance(block, (bytes, bytearray)):
+        nblk = native_bridge.NativeBlock(bytes(block))
+    else:
+        # The cached parse is keyed on a cheap content fingerprint (header
+        # bytes + per-tx txid/wtxid) so a Block mutated between calls is
+        # re-serialized instead of validated stale. Mutating a Tx without
+        # tx.invalidate_caches() leaves stale txids — which misleads the
+        # Python pipeline identically, so the two paths cannot diverge.
+        fp = (
+            block.header.serialize(),
+            tuple(tx.txid for tx in block.vtx),
+            tuple(tx.wtxid for tx in block.vtx),
+        )
+        cached = getattr(block, "_native", None)
+        if cached is not None and cached[0] == fp:
+            nblk = cached[1]
+        else:
+            nblk = native_bridge.NativeBlock(block.serialize())
+            block._native = (fp, nblk)
+
+    phases = verifier.phases if verifier is not None else None
+
+    def phase(name):
+        from contextlib import nullcontext
+
+        return phases(name) if phases is not None else nullcontext()
+
+    with phase("block_check"):
+        reason = nblk.check(check_pow, pow_limit)
+        if reason:
+            return ConnectResult(False, reason)
+        if enforce_witness_commitment is None:
+            enforce_witness_commitment = bool(flags & VERIFY_WITNESS)
+        if enforce_witness_commitment:
+            reason = nblk.check_witness_commitment()
+            if reason:
+                return ConnectResult(False, reason)
+
+    with phase("accounting"):
+        (reason, fees, sigop_cost, tx_index, n_in, amounts, spk_offs,
+         spk_blob) = nblk.accounting(coins, height, flags)
+        if reason:
+            return ConnectResult(False, reason)
+
+    input_results: Optional[List[BatchResult]] = None
+    if check_scripts:
+        if verifier is None:
+            from ..crypto.jax_backend import default_verifier
+
+            verifier = default_verifier()
+        from .sigcache import default_script_cache, default_sig_cache
+
+        if sig_cache is None:
+            sig_cache = default_sig_cache()
+        if script_cache is None:
+            script_cache = default_script_cache()
+
+        n = len(tx_index)
+        with phase("probe"):
+            raw_keys = nblk.script_keys(script_cache._salt, flags).tobytes()
+            keys = [raw_keys[32 * j : 32 * j + 32] for j in range(n)]
+            if len(script_cache) == 0:  # cold cache: every probe misses
+                hit = [False] * n
+            else:
+                hit = [script_cache.contains_key(k) for k in keys]
+
+        nsess = native_bridge.NativeSession()
+        live = [j for j in range(n) if not hit[j]]
+        n_threads = _idx_threads()
+        flags_a = np.full(n, flags, dtype=np.int32)
+
+        # Raw per-tx pointers, resolved once: the NTx objects are owned by
+        # the (live) nblk, so the pointers outlast any handle wrapper.
+        ptr_by_tx = [nblk.tx(t)._ptr for t in range(nblk.n_tx)]
+
+        def run_idx(pos):
+            if len(pos) == n:  # common path: whole block, zero-copy
+                tx_ptrs = [ptr_by_tx[t] for t in tx_index.tolist()]
+                return nsess.verify_inputs_idx_raw(
+                    tx_ptrs, n_in, amounts, spk_blob, spk_offs, flags_a,
+                    n_threads,
+                )
+            sel = np.asarray(pos, dtype=np.int64)
+            sub_offs = np.zeros(len(pos) + 1, dtype=np.int64)
+            chunks = []
+            for k, j in enumerate(pos):
+                chunks.append(spk_blob[int(spk_offs[j]) : int(spk_offs[j + 1])])
+                sub_offs[k + 1] = sub_offs[k] + len(chunks[-1])
+            sub_blob = (
+                np.concatenate(chunks) if chunks else np.zeros(1, np.uint8)
+            )
+            return nsess.verify_inputs_idx_raw(
+                [ptr_by_tx[int(tx_index[j])] for j in pos],
+                n_in[sel], amounts[sel], sub_blob, sub_offs, flags_a[sel],
+                n_threads,
+            )
+
+        def timed_run_idx(pos):
+            with phase("interpret"):
+                return run_idx(pos)
+
+        def exact_fallback(j: int) -> Tuple[bool, int]:
+            t = int(tx_index[j])
+            spk = spk_blob[int(spk_offs[j]) : int(spk_offs[j + 1])].tobytes()
+            okx, err_code, _ = nsess.verify_input(
+                nblk.tx(t), int(n_in[j]), int(amounts[j]), spk, flags,
+                mode=native_bridge.NativeSession.MODE_EXACT,
+            )
+            return okx, err_code
+
+        from .batch import run_idx_fixpoint
+
+        final = run_idx_fixpoint(
+            nsess, verifier, sig_cache, live, timed_run_idx, exact_fallback
+        )
+
+        from ..core.script_error import ScriptError
+
+        input_results = []
+        all_ok = True
+        for j in range(n):
+            if hit[j]:
+                input_results.append(BatchResult.success())
+                continue
+            okj, errj = final[j]
+            if okj:
+                script_cache.add_key(keys[j])
+                input_results.append(BatchResult.success())
+            else:
+                all_ok = False
+                input_results.append(
+                    BatchResult(False, Error.ERR_SCRIPT, ScriptError(errj))
+                )
+        if not all_ok:
+            return ConnectResult(
+                False, "block-validation-failed", fees, sigop_cost,
+                input_results,
+            )
+
+    with phase("apply"):
+        coins.apply_block(nblk, height)
+    return ConnectResult(True, None, fees, sigop_cost, input_results)
 
 
 def _connect_block_impl(

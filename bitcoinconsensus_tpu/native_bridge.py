@@ -26,7 +26,7 @@ import ctypes
 import os
 import subprocess
 import threading
-from typing import List, Optional, Sequence, Tuple
+from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -197,6 +197,45 @@ def lib() -> Optional[ctypes.CDLL]:
         L.nat_session_publish_uniq.argtypes = [vp, i32p, ctypes.c_int32, i32p]
         L.nat_session_uniq_host_verify.argtypes = [vp, ctypes.c_int32]
         L.nat_session_uniq_host_verify.restype = ctypes.c_int32
+        # block layer (native/block.hpp)
+        L.nat_block_parse.argtypes = [u8p, ctypes.c_int64]
+        L.nat_block_parse.restype = vp
+        L.nat_block_free.argtypes = [vp]
+        L.nat_block_n_tx.argtypes = [vp]
+        L.nat_block_n_tx.restype = ctypes.c_int32
+        L.nat_block_n_inputs.argtypes = [vp]
+        L.nat_block_n_inputs.restype = ctypes.c_int32
+        L.nat_block_tx.argtypes = [vp, ctypes.c_int32]
+        L.nat_block_tx.restype = vp
+        L.nat_block_txid.argtypes = [vp, ctypes.c_int32, u8p]
+        L.nat_block_wtxid.argtypes = [vp, ctypes.c_int32, u8p]
+        L.nat_block_check.argtypes = [vp, ctypes.c_int32, u8p, ctypes.c_int32]
+        L.nat_block_check.restype = ctypes.c_int32
+        L.nat_block_check_witness.argtypes = [vp]
+        L.nat_block_check_witness.restype = ctypes.c_int32
+        L.nat_block_accounting.argtypes = [vp, vp, ctypes.c_int64, ctypes.c_int32]
+        L.nat_block_accounting.restype = ctypes.c_int32
+        L.nat_block_acct_meta.argtypes = [vp, i64p, i64p, i64p, i64p]
+        L.nat_block_acct_data.argtypes = [vp, i32p, i32p, i64p, i64p, u8p]
+        L.nat_block_spent_digests.argtypes = [vp, u8p]
+        L.nat_block_script_keys.argtypes = [
+            vp, u8p, ctypes.c_int64, ctypes.c_int32, u8p,
+        ]
+        L.nat_view_new.restype = vp
+        L.nat_view_free.argtypes = [vp]
+        L.nat_view_clone.argtypes = [vp]
+        L.nat_view_clone.restype = vp
+        L.nat_view_len.argtypes = [vp]
+        L.nat_view_len.restype = ctypes.c_int64
+        L.nat_view_add_coins.argtypes = [
+            vp, ctypes.c_int32, u8p, i32p, i64p, i32p, i32p, u8p, i64p,
+        ]
+        L.nat_view_get.argtypes = [vp, u8p, ctypes.c_int32, i64p, i32p, i32p, i64p]
+        L.nat_view_get.restype = ctypes.c_int32
+        L.nat_view_get_spk.argtypes = [vp, u8p, ctypes.c_int32, u8p]
+        L.nat_view_spend.argtypes = [vp, u8p, ctypes.c_int32]
+        L.nat_view_spend.restype = ctypes.c_int32
+        L.nat_view_apply_block.argtypes = [vp, vp, ctypes.c_int64]
         _lib = L
         return _lib
 
@@ -623,6 +662,43 @@ class NativeSession:
             if blob_b
             else np.zeros(1, np.uint8)
         )
+        return self._run_idx(tx_ptrs, nin_a, amt_a, blob, spk_offs, flg_a,
+                             n, n_threads)
+
+    def verify_inputs_idx_raw(
+        self,
+        tx_ptrs: Sequence,
+        n_ins: np.ndarray,
+        amounts: np.ndarray,
+        spk_blob: np.ndarray,
+        spk_offs: np.ndarray,
+        flags: np.ndarray,
+        n_threads: int = 1,
+    ):
+        """Array-native variant of verify_inputs_idx: the scriptPubKeys
+        arrive as one (blob, offs) pair — zero copies when the caller
+        already holds the block accounting's arrays (models/validate.py
+        _connect_block_native). `tx_ptrs` are raw NTx pointers."""
+        n = len(tx_ptrs)
+        if n == 0:
+            z32 = np.zeros(0, np.int32)
+            return z32, z32, z32, z32, np.zeros(1, np.int64)
+        ptrs = (ctypes.c_void_p * n)(*tx_ptrs)
+        nin_a = np.ascontiguousarray(n_ins, dtype=np.int32)
+        amt_a = np.ascontiguousarray(amounts, dtype=np.int64)
+        flg_a = np.ascontiguousarray(flags, dtype=np.int32)
+        offs_a = np.ascontiguousarray(spk_offs, dtype=np.int64)
+        blob = (
+            np.ascontiguousarray(spk_blob, dtype=np.uint8)
+            if len(spk_blob)
+            else np.zeros(1, np.uint8)
+        )
+        return self._run_idx(ptrs, nin_a, amt_a, blob, offs_a, flg_a, n,
+                             n_threads)
+
+    def _run_idx(self, tx_ptrs, nin_a, amt_a, blob, spk_offs, flg_a, n,
+                 n_threads):
+        L = lib()
         ok = np.zeros(n, dtype=np.int32)
         err = np.zeros(n, dtype=np.int32)
         unk = np.zeros(n, dtype=np.int32)
@@ -725,6 +801,302 @@ class NativeSession:
             flags, mode, _i32p(serr), _i32p(unk),
         )
         return bool(ok), int(serr[0]), int(unk[0])
+
+
+# BlkReason code -> reference reject-reason string (native/block.hpp
+# BlkReason order is part of the ABI; index = code).
+BLOCK_REASONS = (
+    None,
+    "high-hash",
+    "bad-txnmrklroot",
+    "bad-txns-duplicate",
+    "bad-blk-length",
+    "bad-cb-missing",
+    "bad-cb-multiple",
+    "bad-txns-vin-empty",
+    "bad-txns-vout-empty",
+    "bad-txns-oversize",
+    "bad-txns-vout-negative",
+    "bad-txns-vout-toolarge",
+    "bad-txns-txouttotal-toolarge",
+    "bad-txns-inputs-duplicate",
+    "bad-cb-length",
+    "bad-txns-prevout-null",
+    "bad-blk-sigops",
+    "bad-witness-nonce-size",
+    "bad-witness-merkle-match",
+    "unexpected-witness",
+    "bad-txns-BIP30",
+    "bad-txns-inputs-missingorspent",
+    "bad-txns-premature-spend-of-coinbase",
+    "bad-txns-inputvalues-outofrange",
+    "bad-txns-in-belowout",
+    "bad-txns-fee-outofrange",
+    "bad-cb-amount",
+    "block-deserialize-failed",
+)
+
+
+class NativeBlockTx:
+    """Borrowed tx handle inside a NativeBlock (NOT freed on __del__ —
+    the block owns it; the `_blk` backref keeps the owner alive for the
+    handle's lifetime). Duck-compatible with NativeTx where the batch
+    drivers need it (._ptr, .n_inputs, .ser_size, .wtxid)."""
+
+    __slots__ = ("_ptr", "_blk", "n_inputs", "ser_size", "_wtxid", "_index",
+                 "__weakref__")
+
+    def __init__(self, blk: "NativeBlock", index: int, ptr):
+        L = lib()
+        self._blk = blk  # keeps the owning block alive
+        self._index = index
+        self._ptr = ptr
+        self.n_inputs = int(L.nat_tx_n_inputs(ptr))
+        self.ser_size = int(L.nat_tx_ser_size(ptr))
+        self._wtxid: Optional[bytes] = None
+
+    @property
+    def wtxid(self) -> bytes:
+        if self._wtxid is None:
+            out = np.zeros(32, dtype=np.uint8)
+            lib().nat_block_wtxid(self._blk._ptr, self._index, _u8p(out))
+            self._wtxid = out.tobytes()
+        return self._wtxid
+
+
+class NativeBlock:
+    """Parsed-block handle (native/block.hpp NBlock): header, txs, txids,
+    and (after `accounting`) the per-input script-phase data."""
+
+    __slots__ = ("_ptr", "n_tx", "n_inputs", "_txs")
+
+    def __init__(self, raw: bytes):
+        L = lib()
+        assert L is not None
+        arr = np.frombuffer(raw, dtype=np.uint8) if raw else np.zeros(1, np.uint8)
+        ptr = L.nat_block_parse(_u8p(arr), len(raw))
+        if not ptr:
+            raise ValueError("block deserialize failed")
+        self._ptr = ptr
+        self.n_tx = int(L.nat_block_n_tx(ptr))
+        self.n_inputs = int(L.nat_block_n_inputs(ptr))
+        # weak values: a NativeBlockTx strongly refs its block, so a
+        # strong cache here would form a cycle only cycle-GC could free —
+        # and the block pipeline runs under gc_paused(). Weak entries die
+        # with their last external ref; recreation is two C calls.
+        import weakref
+
+        self._txs = weakref.WeakValueDictionary()
+
+    def __del__(self):
+        try:
+            L = lib()
+        except TypeError:  # interpreter shutdown tore down module globals
+            return
+        if L is not None and getattr(self, "_ptr", None):
+            L.nat_block_free(self._ptr)
+            self._ptr = None
+
+    def __deepcopy__(self, memo):
+        # A deep copy would duplicate the raw C++ pointer and double-free;
+        # the handle is a drop-on-copy cache (models/validate.py re-parses).
+        return None
+
+    def __reduce__(self):
+        raise TypeError("NativeBlock handles are not picklable")
+
+    def tx(self, i: int) -> NativeBlockTx:
+        t = self._txs.get(i)
+        if t is None:
+            ptr = lib().nat_block_tx(self._ptr, i)
+            assert ptr, i
+            t = self._txs[i] = NativeBlockTx(self, i, ptr)
+        return t
+
+    def txid(self, i: int) -> bytes:
+        out = np.zeros(32, dtype=np.uint8)
+        lib().nat_block_txid(self._ptr, i, _u8p(out))
+        return out.tobytes()
+
+    def wtxid(self, i: int) -> bytes:
+        out = np.zeros(32, dtype=np.uint8)
+        lib().nat_block_wtxid(self._ptr, i, _u8p(out))
+        return out.tobytes()
+
+    def check(self, check_pow: bool, pow_limit: int, check_merkle: bool = True
+              ) -> Optional[str]:
+        """Context-free CheckBlock; returns a reject reason or None."""
+        limit = np.frombuffer(pow_limit.to_bytes(32, "big"), dtype=np.uint8)
+        code = lib().nat_block_check(
+            self._ptr, 1 if check_pow else 0, _u8p(limit),
+            1 if check_merkle else 0,
+        )
+        return BLOCK_REASONS[code]
+
+    def check_witness_commitment(self) -> Optional[str]:
+        return BLOCK_REASONS[lib().nat_block_check_witness(self._ptr)]
+
+    def accounting(self, view: "NativeCoinsView", height: int, flags: int):
+        """ConnectBlock accounting (BIP30, existence/maturity/values, fees,
+        sigop budget) + per-input script-phase data + per-tx hash
+        precompute. Returns (reason|None, fees, sigop_cost, tx_index,
+        n_in, amounts, spk_offs, spk_blob) — arrays one entry per
+        non-coinbase input, in block order."""
+        L = lib()
+        code = L.nat_block_accounting(self._ptr, view._ptr, height, flags)
+        fees = np.zeros(1, np.int64)
+        sigops = np.zeros(1, np.int64)
+        n_in_total = np.zeros(1, np.int64)
+        spk_bytes = np.zeros(1, np.int64)
+        i64c = ctypes.POINTER(ctypes.c_int64)
+        L.nat_block_acct_meta(
+            self._ptr, fees.ctypes.data_as(i64c), sigops.ctypes.data_as(i64c),
+            n_in_total.ctypes.data_as(i64c), spk_bytes.ctypes.data_as(i64c),
+        )
+        if code != 0:
+            return (BLOCK_REASONS[code], int(fees[0]), int(sigops[0])) + (None,) * 5
+        n = int(n_in_total[0])
+        tx_index = np.zeros(max(n, 1), np.int32)
+        n_in = np.zeros(max(n, 1), np.int32)
+        amounts = np.zeros(max(n, 1), np.int64)
+        spk_offs = np.zeros(n + 1, np.int64)
+        spk_blob = np.zeros(max(int(spk_bytes[0]), 1), np.uint8)
+        L.nat_block_acct_data(
+            self._ptr, _i32p(tx_index), _i32p(n_in),
+            amounts.ctypes.data_as(i64c), spk_offs.ctypes.data_as(i64c),
+            _u8p(spk_blob),
+        )
+        return (None, int(fees[0]), int(sigops[0]), tx_index[:n], n_in[:n],
+                amounts[:n], spk_offs, spk_blob)
+
+    def spent_digests(self) -> np.ndarray:
+        """(n_tx, 32) per-tx spent-output digests (coinbase rows zero);
+        valid after a successful accounting() call."""
+        out = np.zeros((self.n_tx, 32), dtype=np.uint8)
+        lib().nat_block_spent_digests(self._ptr, _u8p(out))
+        return out
+
+    def script_keys(self, salt: bytes, flags: int) -> np.ndarray:
+        """(n_inputs, 32) script-execution-cache keys for every
+        non-coinbase input (byte-identical to ScriptExecutionCache
+        `_key(_parts(...))`; valid after a successful accounting())."""
+        out = np.zeros((self.n_inputs, 32), dtype=np.uint8)
+        salt_a = (
+            np.frombuffer(salt, dtype=np.uint8) if salt else np.zeros(1, np.uint8)
+        )
+        lib().nat_block_script_keys(
+            self._ptr, _u8p(salt_a), len(salt), flags, _u8p(out)
+        )
+        return out
+
+
+class NativeCoinsView:
+    """Native UTXO set (native/block.hpp NView) with the models/validate.py
+    CoinsView duck API plus batch insert and O(1) clone."""
+
+    __slots__ = ("_ptr",)
+
+    def __init__(self, _ptr=None):
+        if _ptr is None:
+            L = lib()
+            assert L is not None
+            _ptr = L.nat_view_new()
+        self._ptr = _ptr
+
+    def __del__(self):
+        try:
+            L = lib()
+        except TypeError:
+            return
+        if L is not None and getattr(self, "_ptr", None):
+            L.nat_view_free(self._ptr)
+            self._ptr = None
+
+    def clone(self) -> "NativeCoinsView":
+        return NativeCoinsView(lib().nat_view_clone(self._ptr))
+
+    def __deepcopy__(self, memo) -> "NativeCoinsView":
+        return self.clone()
+
+    def __len__(self) -> int:
+        return int(lib().nat_view_len(self._ptr))
+
+    def add_coins_batch(self, coins) -> None:
+        """coins: sequence of (txid32, n, value, height, coinbase, spk)."""
+        L = lib()
+        n = len(coins)
+        if n == 0:
+            return
+        txids = np.frombuffer(
+            b"".join(c[0] for c in coins), dtype=np.uint8
+        )
+        ns = np.asarray([c[1] for c in coins], dtype=np.int32)
+        values = np.asarray([c[2] for c in coins], dtype=np.int64)
+        heights = np.asarray([c[3] for c in coins], dtype=np.int32)
+        cbs = np.asarray([1 if c[4] else 0 for c in coins], dtype=np.int32)
+        offs = np.zeros(n + 1, dtype=np.int64)
+        for i, c in enumerate(coins):
+            offs[i + 1] = offs[i] + len(c[5])
+        blob_b = b"".join(c[5] for c in coins)
+        blob = (
+            np.frombuffer(blob_b, dtype=np.uint8)
+            if blob_b
+            else np.zeros(1, np.uint8)
+        )
+        L.nat_view_add_coins(
+            self._ptr, n, _u8p(txids), _i32p(ns),
+            values.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            _i32p(heights), _i32p(cbs), _u8p(blob),
+            offs.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        )
+
+    # CoinsView duck API (models/validate.py) -------------------------
+    def add(self, outpoint, coin) -> None:
+        self.add_coins_batch(
+            [(outpoint.hash, outpoint.n, coin.out.value, coin.height,
+              coin.coinbase, coin.out.script_pubkey)]
+        )
+
+    def add_tx(self, tx, height: int) -> None:
+        cb = tx.is_coinbase()
+        self.add_coins_batch(
+            [(tx.txid, n, out.value, height, cb, out.script_pubkey)
+             for n, out in enumerate(tx.vout)]
+        )
+
+    def get(self, outpoint):
+        L = lib()
+        txid = np.frombuffer(outpoint.hash, dtype=np.uint8)
+        value = np.zeros(1, np.int64)
+        height = np.zeros(1, np.int32)
+        cb = np.zeros(1, np.int32)
+        spk_len = np.zeros(1, np.int64)
+        i64c = ctypes.POINTER(ctypes.c_int64)
+        found = L.nat_view_get(
+            self._ptr, _u8p(txid), outpoint.n, value.ctypes.data_as(i64c),
+            _i32p(height), _i32p(cb), spk_len.ctypes.data_as(i64c),
+        )
+        if not found:
+            return None
+        spk = np.zeros(max(int(spk_len[0]), 1), np.uint8)
+        L.nat_view_get_spk(self._ptr, _u8p(txid), outpoint.n, _u8p(spk))
+        from .core.tx import TxOut
+        from .models.validate import Coin
+
+        return Coin(
+            TxOut(int(value[0]), spk[: int(spk_len[0])].tobytes()),
+            int(height[0]), bool(cb[0]),
+        )
+
+    def spend(self, outpoint):
+        coin = self.get(outpoint)
+        if coin is not None:
+            txid = np.frombuffer(outpoint.hash, dtype=np.uint8)
+            lib().nat_view_spend(self._ptr, _u8p(txid), outpoint.n)
+        return coin
+
+    def apply_block(self, blk: NativeBlock, height: int) -> None:
+        lib().nat_view_apply_block(self._ptr, blk._ptr, height)
 
 
 class NativeSecp:

@@ -354,8 +354,7 @@ struct NTx {
 // INCREMENTALLY (one entry per parsed element, each consuming >= 1 input
 // byte) — never pre-sized from the attacker-claimed CompactSize, so a
 // tiny malformed tx cannot demand a multi-GB allocation.
-inline NTx* tx_parse(const u8* data, size_t len) {
-    Reader r(data, len);
+inline NTx* tx_parse_from(Reader& r) {
     auto tx = std::make_unique<NTx>();
     tx->version = r.read_i32();
     u8 flags = 0;
@@ -404,6 +403,11 @@ inline NTx* tx_parse(const u8* data, size_t len) {
     tx->locktime = r.read_u32();
     tx->ser_size = (i64)tx->serialize(true).size();
     return tx.release();
+}
+
+inline NTx* tx_parse(const u8* data, size_t len) {
+    Reader r(data, len);
+    return tx_parse_from(r);
 }
 
 // --------------------------------------------------------------------------

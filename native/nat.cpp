@@ -12,6 +12,7 @@
 // Layouts must stay bit-identical to the Python packers; the test suite
 // asserts equality lane by lane (tests/test_native.py).
 
+#include "block.hpp"
 #include "eval.hpp"
 #include "secp.hpp"
 
@@ -198,8 +199,189 @@ int bc_verify(const u8* spk, u32 spk_len, i64 amount, const u8* tx_to,
 
 extern "C" {
 
-// 4: nat_session_recidx_data grew a capacity argument + i64 return.
+// 4: nat_session_recidx_data grew a capacity argument + i64 return;
+//    the nat_block_* / nat_view_* block layer landed.
 int nat_version() { return 4; }
+
+// --- Block layer (native/block.hpp) ---------------------------------------
+
+void* nat_block_parse(const u8* data, i64 len) {
+    try {
+        return block_parse(data, (size_t)len);
+    } catch (...) {
+        return nullptr;
+    }
+}
+
+void nat_block_free(void* b) { delete static_cast<NBlock*>(b); }
+
+i32 nat_block_n_tx(void* b) {
+    return (i32)static_cast<NBlock*>(b)->vtx.size();
+}
+
+// Total non-coinbase inputs (the script-phase lane count).
+i32 nat_block_n_inputs(void* b) {
+    auto* blk = static_cast<NBlock*>(b);
+    i64 n = 0;
+    for (const auto& tx : blk->vtx)
+        if (!tx_is_coinbase(*tx)) n += (i64)tx->vin.size();
+    return (i32)n;
+}
+
+// Borrowed pointer into the block (freed with the block, never by
+// nat_tx_free).
+void* nat_block_tx(void* b, i32 i) {
+    auto* blk = static_cast<NBlock*>(b);
+    if (i < 0 || (size_t)i >= blk->vtx.size()) return nullptr;
+    return blk->vtx[(size_t)i].get();
+}
+
+void nat_block_txid(void* b, i32 i, u8* out32) {
+    auto* blk = static_cast<NBlock*>(b);
+    std::memcpy(out32, blk->txids[(size_t)i].data(), 32);
+}
+
+void nat_block_wtxid(void* b, i32 i, u8* out32) {
+    auto* blk = static_cast<NBlock*>(b);
+    std::memcpy(out32, blk->wtxids[(size_t)i].data(), 32);
+}
+
+// Context-free CheckBlock; returns a BlkReason code (0 = ok).
+i32 nat_block_check(void* b, i32 do_pow, const u8* pow_limit_be,
+                    i32 do_merkle) {
+    return check_block(*static_cast<NBlock*>(b), do_pow != 0, pow_limit_be,
+                       do_merkle != 0);
+}
+
+i32 nat_block_check_witness(void* b) {
+    return check_witness_commitment(*static_cast<NBlock*>(b));
+}
+
+i32 nat_block_accounting(void* b, void* v, i64 height, i32 flags) {
+    return block_accounting(*static_cast<NBlock*>(b),
+                            *static_cast<NView*>(v), height, (u32)flags);
+}
+
+void nat_block_acct_meta(void* b, i64* fees, i64* sigop_cost, i64* n_inputs,
+                         i64* spk_bytes) {
+    const BlockAcct& A = static_cast<NBlock*>(b)->acct;
+    *fees = A.fees;
+    *sigop_cost = A.sigop_cost;
+    *n_inputs = (i64)A.tx_index.size();
+    *spk_bytes = (i64)A.spk_blob.size();
+}
+
+void nat_block_acct_data(void* b, i32* tx_index, i32* n_in, i64* amounts,
+                         i64* spk_offs, u8* spk_blob) {
+    const BlockAcct& A = static_cast<NBlock*>(b)->acct;
+    size_t n = A.tx_index.size();
+    if (n) {
+        std::memcpy(tx_index, A.tx_index.data(), n * sizeof(i32));
+        std::memcpy(n_in, A.n_in.data(), n * sizeof(i32));
+        std::memcpy(amounts, A.amounts.data(), n * sizeof(i64));
+    }
+    std::memcpy(spk_offs, A.spk_offs.data(), (n + 1) * sizeof(i64));
+    if (!A.spk_blob.empty())
+        std::memcpy(spk_blob, A.spk_blob.data(), A.spk_blob.size());
+}
+
+// Per-tx spent-output digests (models/sigcache.py spent_digest stream);
+// coinbase rows are zero. out: n_tx * 32 bytes.
+void nat_block_spent_digests(void* b, u8* out) {
+    const BlockAcct& A = static_cast<NBlock*>(b)->acct;
+    for (size_t t = 0; t < A.spent_digests.size(); t++)
+        std::memcpy(out + 32 * t, A.spent_digests[t].data(), 32);
+}
+
+// Script-execution-cache keys for every non-coinbase input (valid after
+// accounting): the models/sigcache.py `_key(_parts(wtxid, n_in, flags,
+// spent_digest))` stream — sha256(salt || [len(part) 4LE || part]...)
+// with parts (wtxid32, n_in 4LE, flags 4LE, digest32). out: n_inputs*32.
+void nat_block_script_keys(void* b, const u8* salt, i64 salt_len, i32 flags,
+                           u8* out) {
+    auto* blk = static_cast<NBlock*>(b);
+    const BlockAcct& A = blk->acct;
+    auto part = [](Sha256& h, const u8* p, u32 len) {
+        u8 lb[4] = {u8(len), u8(len >> 8), u8(len >> 16), u8(len >> 24)};
+        h.write(lb, 4);
+        h.write(p, len);
+    };
+    u8 f4[4] = {u8(flags), u8(flags >> 8), u8(flags >> 16), u8(flags >> 24)};
+    // One midstate per (salt); wtxid/digest swap per tx.
+    for (size_t j = 0; j < A.tx_index.size(); j++) {
+        i32 t = A.tx_index[j];
+        Sha256 h;
+        h.write(salt, (size_t)salt_len);
+        part(h, blk->wtxids[(size_t)t].data(), 32);
+        i32 n = A.n_in[j];
+        u8 n4[4] = {u8(n), u8(n >> 8), u8(n >> 16), u8(n >> 24)};
+        part(h, n4, 4);
+        part(h, f4, 4);
+        part(h, A.spent_digests[(size_t)t].data(), 32);
+        h.finalize(out + 32 * j);
+    }
+}
+
+void* nat_view_new() { return new NView(); }
+
+void nat_view_free(void* v) { delete static_cast<NView*>(v); }
+
+void* nat_view_clone(void* v) {
+    return new NView(*static_cast<NView*>(v));
+}
+
+i64 nat_view_len(void* v) {
+    return (i64)static_cast<NView*>(v)->map.size();
+}
+
+// Batch coin insert: coin i is (txids[32i..32i+32), ns[i]) ->
+// (values[i], heights[i], coinbases[i], spk_blob[spk_offs[i]..spk_offs[i+1])).
+void nat_view_add_coins(void* v, i32 n, const u8* txids, const i32* ns,
+                        const i64* values, const i32* heights,
+                        const i32* coinbases, const u8* spk_blob,
+                        const i64* spk_offs) {
+    auto* view = static_cast<NView*>(v);
+    for (i32 i = 0; i < n; i++) {
+        NCoin c;
+        c.value = values[i];
+        c.height = heights[i];
+        c.coinbase = coinbases[i] != 0;
+        c.spk.assign(spk_blob + spk_offs[i], spk_blob + spk_offs[i + 1]);
+        view->map[NView::key(txids + 32 * (size_t)i, (u32)ns[i])] =
+            std::move(c);
+    }
+}
+
+// Point query: returns 1 if present (filling value/height/coinbase/spk_len),
+// else 0. The scriptPubKey bytes follow via nat_view_get_spk.
+i32 nat_view_get(void* v, const u8* txid, i32 n, i64* value, i32* height,
+                 i32* coinbase, i64* spk_len) {
+    auto* view = static_cast<NView*>(v);
+    auto it = view->map.find(NView::key(txid, (u32)n));
+    if (it == view->map.end()) return 0;
+    *value = it->second.value;
+    *height = it->second.height;
+    *coinbase = it->second.coinbase ? 1 : 0;
+    *spk_len = (i64)it->second.spk.size();
+    return 1;
+}
+
+void nat_view_get_spk(void* v, const u8* txid, i32 n, u8* out) {
+    auto* view = static_cast<NView*>(v);
+    auto it = view->map.find(NView::key(txid, (u32)n));
+    if (it == view->map.end()) return;
+    std::memcpy(out, it->second.spk.data(), it->second.spk.size());
+}
+
+i32 nat_view_spend(void* v, const u8* txid, i32 n) {
+    auto* view = static_cast<NView*>(v);
+    return view->map.erase(NView::key(txid, (u32)n)) ? 1 : 0;
+}
+
+void nat_view_apply_block(void* v, void* b, i64 height) {
+    view_apply_block(*static_cast<NView*>(v), *static_cast<NBlock*>(b),
+                     height);
+}
 
 // The three libbitcoinconsensus exports (bitcoinconsensus.h:67-75).
 

@@ -350,12 +350,18 @@ def test_adversarial_multisig_oracle_work_is_bounded():
     verifier = TpuSecpVerifier(min_batch=8)
     dispatches = []
     orig = verifier.verify_checks
+    orig_lanes = verifier.dispatch_lanes
 
     def counting(checks):
         dispatches.append(len(checks))
         return orig(checks)
 
+    def counting_lanes(args, n):  # the index-mode driver's dispatch seam
+        dispatches.append(n)
+        return orig_lanes(args, n)
+
     verifier.verify_checks = counting
+    verifier.dispatch_lanes = counting_lanes
     res = verify_batch(
         items, verifier=verifier, sig_cache=SigCache(),
         script_cache=ScriptExecutionCache(),

@@ -177,12 +177,18 @@ def test_misaligned_multisig_single_dispatch():
     verifier = TpuSecpVerifier()
     calls = []
     orig = verifier.verify_checks
+    orig_lanes = verifier.dispatch_lanes
 
     def counting(checks):
         calls.append(len(checks))
         return orig(checks)
 
+    def counting_lanes(args, n):  # the index-mode driver's dispatch seam
+        calls.append(n)
+        return orig_lanes(args, n)
+
     verifier.verify_checks = counting
+    verifier.dispatch_lanes = counting_lanes
     res = verify_batch(
         [item], verifier=verifier, sig_cache=SigCache(),
         script_cache=ScriptExecutionCache(),

@@ -177,6 +177,54 @@ def test_idx_then_wire_protocol_mixing():
     assert unk1 > 0 and len(sess2.take_records()) == unk1
 
 
+def test_idx_driver_matches_wire_driver(monkeypatch):
+    """verify_batch through the index-mode fast driver vs the legacy wire
+    driver: identical BatchResults (ok/Error/ScriptError) on a mixed
+    corpus with failures, transport errors and a misaligned multisig."""
+    from bitcoinconsensus_tpu.core.flags import VERIFY_TAPROOT
+    from bitcoinconsensus_tpu.crypto.jax_backend import TpuSecpVerifier
+    from bitcoinconsensus_tpu.models.batch import BatchItem, verify_batch
+    from bitcoinconsensus_tpu.models.sigcache import ScriptExecutionCache
+
+    kinds = ("p2wpkh", "p2tr", "p2wsh_multisig")
+    _, funded = make_funded_view(9, kinds=kinds, seed="idx-drv")
+    tx = build_spend_tx(funded, fee=900)
+    # corrupt input 4's witness signature
+    w = list(tx.vin[4].witness)
+    j = 0 if len(w[0]) else 1
+    w[j] = w[j][:6] + bytes([w[j][6] ^ 1]) + w[j][7:]
+    tx.vin[4].witness = w
+    raw = tx.serialize()
+    outs = [(f.amount, f.wallet.spk) for f in funded]
+    items = [
+        BatchItem(raw, i, VERIFY_ALL_EXTENDED, spent_outputs=outs)
+        for i in range(9)
+    ]
+    # transport-error items ride along: bad index, truncated tx, bad flags
+    items.append(BatchItem(raw, 99, VERIFY_ALL_EXTENDED, spent_outputs=outs))
+    items.append(BatchItem(raw[:-4], 0, VERIFY_ALL_EXTENDED, spent_outputs=outs))
+    items.append(
+        BatchItem(raw, 0, VERIFY_TAPROOT, spent_output_script=outs[0][1], amount=outs[0][0])
+    )
+
+    def run(idx_on: bool):
+        if idx_on:
+            monkeypatch.delenv("BITCOINCONSENSUS_TPU_IDX", raising=False)
+        else:
+            monkeypatch.setenv("BITCOINCONSENSUS_TPU_IDX", "0")
+        return verify_batch(
+            items, verifier=TpuSecpVerifier(min_batch=8),
+            sig_cache=SigCache(), script_cache=ScriptExecutionCache(),
+        )
+
+    fast = run(True)
+    wire = run(False)
+    assert [(r.ok, r.error, r.script_error) for r in fast] == [
+        (r.ok, r.error, r.script_error) for r in wire
+    ]
+    assert [r.ok for r in fast[:9]] == [True] * 4 + [False] + [True] * 4
+
+
 def test_recidx_capacity_clamp():
     """nat_session_recidx_data copies at most `capacity` entries."""
     import ctypes

@@ -24,6 +24,10 @@ class CountingVerifier(TpuSecpVerifier):
         self.dispatched += len(checks)
         return default_verifier().verify_checks(checks)
 
+    def dispatch_lanes(self, args, n):  # the index-mode driver's seam
+        self.dispatched += n
+        return super().dispatch_lanes(args, n)
+
 
 def _items(seeds, corrupt=()):
     items = []
