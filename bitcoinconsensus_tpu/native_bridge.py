@@ -125,6 +125,8 @@ def lib() -> Optional[ctypes.CDLL]:
         L.nat_verify_schnorr.restype = ctypes.c_int
         L.nat_tweak_add_check.argtypes = [u8p, ctypes.c_int32, u8p, u8p]
         L.nat_tweak_add_check.restype = ctypes.c_int
+        L.nat_murmur3_32.argtypes = [ctypes.c_uint32, u8p, ctypes.c_int64]
+        L.nat_murmur3_32.restype = ctypes.c_uint32
         L.nat_sha256.argtypes = [u8p, ctypes.c_int64, u8p]
         L.nat_sha256d.argtypes = [u8p, ctypes.c_int64, u8p]
         L.nat_tagged_hash.argtypes = [u8p, ctypes.c_int64, u8p, ctypes.c_int64, u8p]

@@ -133,8 +133,9 @@ def _madd_core(X1, Y1, Z1, x2, y2, inf1):
     """Generic madd-2007-bl formula + exceptional-case masks (the shared
     math of the complete and flagged mixed-add variants; one source so the
     two kernels cannot diverge). Returns (generic_triple, h_zero, r_zero,
-    z1_zero) where z1_zero follows the inf1 convention (None -> computed,
-    False -> statically finite, mask -> as given)."""
+    z1_zero, H) where z1_zero follows the inf1 convention (None ->
+    computed, False -> statically finite, mask -> as given); H = U2 - X1
+    satisfies Z3 = 2*Z1*H (the global-Z ratio callers may record)."""
     Z1Z1 = fe_sqr(Z1)
     U2 = fe_mul(x2, Z1Z1)
     S2 = fe_mul(y2, fe_mul(Z1, Z1Z1))
@@ -154,7 +155,7 @@ def _madd_core(X1, Y1, Z1, x2, y2, inf1):
     X3 = fe_sub(fe_sqr(r), fe_add(J, fe_mul_small(V, 2)))
     Y3 = fe_sub(fe_mul(r, fe_sub(V, X3)), fe_mul_small(fe_mul(Y1, J), 2))
     Z3 = fe_sub(fe_sqr(fe_add(Z1, H)), fe_add(Z1Z1, HH))
-    return (X3, Y3, Z3), h_zero, r_zero, z1_zero
+    return (X3, Y3, Z3), h_zero, r_zero, z1_zero, H
 
 
 def _madd_lift(out, X1, x2, y2, z1_zero):
@@ -176,7 +177,7 @@ def jacobian_madd_complete(X1, Y1, Z1, x2, y2, inf1=None):
     finite on every live lane, a mask uses it directly. Loop callers that
     track infinity explicitly skip one of the three exact-zero chains.
     """
-    out, h_zero, r_zero, z1_zero = _madd_core(X1, Y1, Z1, x2, y2, inf1)
+    out, h_zero, r_zero, z1_zero, _H = _madd_core(X1, Y1, Z1, x2, y2, inf1)
     dbl = jacobian_double(X1, Y1, Z1)
     out = _select(h_zero & r_zero, dbl, out)
     out = _select(h_zero & ~r_zero, _inf_like(X1), out)
@@ -248,7 +249,7 @@ def jacobian_madd_flagged(X1, Y1, Z1, x2, y2, inf1):
     complete variant. `inf1` is the caller-tracked infinity mask of the
     left operand (or False when statically finite). Returns
     (X, Y, Z, out_inf, needs_dbl)."""
-    out, h_zero, r_zero, z1_zero = _madd_core(X1, Y1, Z1, x2, y2, inf1)
+    out, h_zero, r_zero, z1_zero, _H = _madd_core(X1, Y1, Z1, x2, y2, inf1)
     out = _select(h_zero & ~r_zero, _inf_like(X1), out)
     if z1_zero is False:
         # Caller-asserted finite left operand: no lift select needed.
@@ -257,6 +258,28 @@ def jacobian_madd_flagged(X1, Y1, Z1, x2, y2, inf1):
     out_inf = ~z1_zero & h_zero & ~r_zero
     needs_dbl = ~z1_zero & h_zero & r_zero
     return out + (out_inf, needs_dbl)
+
+
+def jacobian_madd_flagged_ratio(X1, Y1, Z1, x2, y2, inf1=False):
+    """`jacobian_madd_flagged` that also returns the Z-ratio
+    ``Z3/Z1 = 2H`` (madd-2007-bl: Z3 = (Z1+H)^2 - Z1Z1 - HH = 2*Z1*H).
+    The per-lane table build records these ratios so the whole table can
+    be renormalized to the LAST entry's Z with multiplications only — the
+    reference's effective-affine/global-Z trick
+    (`secp256k1/src/ecmult_impl.h:61-136` odd-multiples table +
+    `secp256k1_ge_table_set_globalz`) — no field inversion. Exceptional
+    lanes (h ≡ 0) produce a meaningless ratio; callers defer those lanes
+    to the host via the needs flag, so the garbage never reaches a
+    verdict. Returns (X, Y, Z, out_inf, needs_dbl, ratio)."""
+    out, h_zero, r_zero, z1_zero, H = _madd_core(X1, Y1, Z1, x2, y2, inf1)
+    ratio = fe_mul_small(H, 2)
+    out = _select(h_zero & ~r_zero, _inf_like(X1), out)
+    if z1_zero is False:
+        return out + (h_zero & ~r_zero, h_zero & r_zero, ratio)
+    out = _madd_lift(out, X1, x2, y2, z1_zero)
+    out_inf = ~z1_zero & h_zero & ~r_zero
+    needs_dbl = ~z1_zero & h_zero & r_zero
+    return out + (out_inf, needs_dbl, ratio)
 
 
 def jacobian_add_flagged(X1, Y1, Z1, X2, Y2, Z2, inf2, inf1):

@@ -200,6 +200,20 @@ def _cat_rows(parts):
     return parts[0] if len(parts) == 1 else jnp.concatenate(parts, axis=0)
 
 
+def _pad_rows(x, lo: int, hi: int):
+    """x padded with `lo` zero rows below and `hi` above, as ONE lax.pad
+    op — the convolutions pad every row product into the output width,
+    and materializing the zeros as separate arrays + concatenate doubled
+    the kernel's data-movement op count (see scripts/kernel_roofline.py
+    `move_ops_per_lane`)."""
+    if lo == 0 and hi == 0:
+        return x
+    from jax import lax
+
+    cfg = [(lo, hi, 0)] + [(0, 0, 0)] * (x.ndim - 1)
+    return lax.pad(x, jnp.zeros((), dtype=x.dtype), cfg)
+
+
 def _pass(x, bounds: Bounds) -> Tuple[jnp.ndarray, Bounds]:
     """One parallel carry pass along the limb axis.
 
@@ -211,7 +225,7 @@ def _pass(x, bounds: Bounds) -> Tuple[jnp.ndarray, Bounds]:
     n = x.shape[0]
     c = x >> RADIX
     kept = x & MASK
-    out = kept + jnp.concatenate([_zeros_rows(x, 1), c[:-1]], axis=0)
+    out = kept + _pad_rows(c[:-1], 1, 0)
     cb = [b >> RADIX for b in bounds]
     b2 = [min(bounds[0], MASK)] + [
         min(bounds[i], MASK) + cb[i - 1] for i in range(1, n)
@@ -223,7 +237,7 @@ def _pass(x, bounds: Bounds) -> Tuple[jnp.ndarray, Bounds]:
         wrap = jnp.stack(
             [top * _FOLD260[0], top * _FOLD260[1], top * _FOLD260[2]], axis=0
         )
-        out = out + jnp.concatenate([wrap, _zeros_rows(x, NLIMB - 3)], axis=0)
+        out = out + _pad_rows(wrap, 0, NLIMB - 3)
         for j, f in enumerate(_FOLD260):
             b2[j] += cb[-1] * f
             assert b2[j] < 2**31
@@ -240,12 +254,10 @@ def _fold_high(x, bounds: Bounds) -> Tuple[jnp.ndarray, Bounds]:
     out_len = max(NLIMB, n_hi + len(_FOLD260) - 1)
     lo, hi = x[:NLIMB], x[NLIMB:]
     pad = out_len - NLIMB
-    acc = _cat_rows([lo, _zeros_rows(x, pad)]) if pad else lo
+    acc = _pad_rows(lo, 0, pad) if pad else lo
     b2 = bounds[:NLIMB] + [0] * pad
     for j, f in enumerate(_FOLD260):
-        zl = _zeros_rows(x, j)
-        zr = _zeros_rows(x, out_len - j - n_hi)
-        acc = acc + _cat_rows([zl, hi * f, zr])
+        acc = acc + _pad_rows(hi * f, j, out_len - j - n_hi)
         for i in range(n_hi):
             b2[i + j] += bounds[NLIMB + i] * f
             assert b2[i + j] < 2**31
@@ -318,52 +330,151 @@ def fe_mul_small(a, k: int):
     return _settle(a * k, [w * k for w in W2])
 
 
-def _conv_rows(a, b, bw: Bounds, aw: Bounds):
-    """Schoolbook convolution: out[k] = sum_{i+j=k} a[i]*b[j]."""
-    out_len = 2 * NLIMB - 1
+def _conv_rows(a, b, bw: Bounds, aw: Bounds, nl: int = NLIMB):
+    """Schoolbook convolution: out[k] = sum_{i+j=k} a[i]*b[j] over nl-limb
+    operands."""
+    out_len = 2 * nl - 1
     acc = None
     bounds = [0] * out_len
-    for i in range(NLIMB):
-        row = a[i] * b  # (NLIMB, ...) scaled by one limb of a
-        padded = _cat_rows(
-            [_zeros_rows(b, i), row, _zeros_rows(b, out_len - i - NLIMB)]
-        )
+    for i in range(nl):
+        row = a[i] * b  # (nl, ...) scaled by one limb of a
+        padded = _pad_rows(row, i, out_len - i - nl)
         acc = padded if acc is None else acc + padded
-        for j in range(NLIMB):
+        for j in range(nl):
             bounds[i + j] += aw[i] * bw[j]
     assert all(bv < 2**31 for bv in bounds)
     return acc, bounds
 
 
+def _conv_rows_kara(a, b, aw: Bounds, bw: Bounds, nl: int):
+    """One Karatsuba level over an nl-limb convolution whose TRUE weights
+    are aw/bw (all columns of all three sub-convolutions provably below
+    2^31 — only usable for real-weight operands, not for the wrapping
+    sum-convolution of the outer level). nl must be even."""
+    h = nl // 2
+    alo, ahi = a[:h], a[h:nl]
+    blo, bhi = b[:h], b[h:nl]
+    z0, b0 = _conv_rows(alo, blo, bw[:h], aw[:h], nl=h)
+    z2, b2 = _conv_rows(ahi, bhi, bw[h:nl], aw[h:nl], nl=h)
+    S = None
+    asum, bsum = alo + ahi, blo + bhi
+    for i in range(h):
+        row = asum[i] * bsum
+        padded = _pad_rows(row, i, h - 1 - i)
+        S = padded if S is None else S + padded
+    z1b = _cross_bounds(aw, bw, h)
+    return _kara_combine(z0, b0, z2, b2, S, z1b, h, 2 * nl - 1)
+
+
+def _sqr_rows(a, aw: Bounds, nl: int):
+    """Squaring convolution over nl limbs: diagonal once + doubled cross
+    terms — ~45% fewer multiplies than the generic convolution."""
+    out_len = 2 * nl - 1
+    acc = None
+    bounds = [0] * out_len
+    a2 = a * 2
+    for i in range(nl):
+        hi = nl - i - 1
+        diag = a[i : i + 1] * a[i : i + 1]
+        # hi == 0 (last limb): the cross-term slice would be zero-size,
+        # which Mosaic rejects — emit the diagonal alone.
+        row = _cat_rows([diag, a[i] * a2[i + 1 : nl]]) if hi else diag
+        padded = _pad_rows(row, 2 * i, out_len - 2 * i - 1 - hi)
+        acc = padded if acc is None else acc + padded
+        bounds[2 * i] += aw[i] * aw[i]
+        for j in range(i + 1, nl):
+            bounds[i + j] += 2 * aw[i] * aw[j]
+    assert all(bv < 2**31 for bv in bounds)
+    return acc, bounds
+
+
+# Karatsuba split: 20 = 10 + 10. One level replaces the 400-product
+# schoolbook convolution with three 100-product half-convolutions plus
+# O(n) combines (~25% fewer per-lane ops where the kernel spends most of
+# its time). Exactness under int32 WRAPPING: XLA int32 add/mul are
+# two's-complement (exact mod 2^32); the sum-convolution S may exceed
+# 2^31 and wrap, but z1 = S - z0 - z2 is computed mod 2^32 and its TRUE
+# value (the cross convolution, statically bounded below 2^31 by the
+# asserted bounds) is therefore recovered exactly. The assembled columns
+# are sums of sub-convolution TAILS with HEADS, so their true bounds stay
+# below 2^31 (asserted), keeping _settle's nonnegative-value semantics.
+_KARA_LO = 10
+
+
+def _cross_bounds(wa: Bounds, wb: Bounds, h: int) -> Bounds:
+    """True per-column bounds of the CROSS convolution lo*hi + hi*lo —
+    what z1 = S - z0 - z2 recovers exactly despite S wrapping."""
+    z1b = [0] * (2 * h - 1)
+    for i in range(h):
+        for j in range(h):
+            z1b[i + j] += wa[i] * wb[h + j] + wa[h + i] * wb[j]
+    return z1b
+
+
+def _kara_combine(z0, b0, z2, b2, S, z1_true_bounds, h: int, out_len: int):
+    """Assemble z0 + (S - z0 - z2)<<(RADIX*h) + z2<<(RADIX*2h) with static
+    bounds; returns (acc, bounds) shaped like an out_len-column
+    convolution. Shared by both Karatsuba levels (fe_mul/fe_sqr outer,
+    _conv_rows_kara inner) so the overflow bookkeeping lives once."""
+    z1 = S - z0 - z2  # exact mod 2^32; true value bounded by z1_true_bounds
+    for tb in z1_true_bounds:
+        assert 0 <= tb < 2**31
+    acc = _pad_rows(z0, 0, out_len - (2 * h - 1))
+    acc = acc + _pad_rows(z1, h, out_len - h - (2 * h - 1))
+    acc = acc + _pad_rows(z2, 2 * h, out_len - 2 * h - (2 * h - 1))
+    bounds = [0] * out_len
+    for k in range(2 * h - 1):
+        bounds[k] += b0[k]
+        bounds[k + h] += z1_true_bounds[k]
+        bounds[k + 2 * h] += b2[k]
+    assert all(bv < 2**31 for bv in bounds)
+    return acc, bounds
+
+
 def fe_mul(a, b):
-    """a * b mod p (weak in, weak out). 400 int32 MACs/lane + parallel
-    carry passes — the per-lane unit the whole verify kernel reduces to."""
-    acc, bounds = _conv_rows(a, b, W2, W2)
+    """a * b mod p (weak in, weak out): one-level Karatsuba over the limb
+    convolution + parallel carry passes — the per-lane unit the whole
+    verify kernel reduces to."""
+    h = _KARA_LO
+    alo, ahi = a[:h], a[h:]
+    blo, bhi = b[:h], b[h:]
+    wlo, whi = W2[:h], W2[h:]
+    # The real-weight halves take a second Karatsuba level (their columns
+    # stay provably below 2^31); the wrapping sum-convolution cannot.
+    z0, b0 = _conv_rows_kara(alo, blo, wlo, wlo, nl=h)
+    z2, b2 = _conv_rows_kara(ahi, bhi, whi, whi, nl=h)
+    asum, bsum = alo + ahi, blo + bhi
+    # The sum-convolution is inlined (NOT via _conv_rows) because its
+    # columns may exceed 2^31 and wrap — which is exact mod 2^32, but
+    # would trip _conv_rows's nonnegative static-bound assertion.
+    S = None
+    for i in range(h):
+        padded = _pad_rows(asum[i] * bsum, i, h - 1 - i)
+        S = padded if S is None else S + padded
+    z1b = _cross_bounds(W2, W2, h)
+    acc, bounds = _kara_combine(z0, b0, z2, b2, S, z1b, h, 2 * NLIMB - 1)
     return _settle(acc, bounds)
 
 
 def fe_sqr(a):
-    """a^2 mod p: off-diagonal products shared (2*a_i*a_j), ~45% fewer
-    multiplies than fe_mul — doublings are squaring-heavy, this matters."""
-    out_len = 2 * NLIMB - 1
-    acc = None
-    bounds = [0] * out_len
-    a2 = a * 2
-    for i in range(NLIMB):
-        # diagonal a_i^2 once + doubled cross terms a_i * a_j (j > i).
-        hi = NLIMB - i - 1
-        diag = a[i : i + 1] * a[i : i + 1]
-        # hi == 0 (last limb): the cross-term slice would be zero-size,
-        # which Mosaic rejects — emit the diagonal alone.
-        row = _cat_rows([diag, a[i] * a2[i + 1 :]]) if hi else diag
-        padded = _cat_rows(
-            [_zeros_rows(a, 2 * i), row, _zeros_rows(a, out_len - 2 * i - 1 - hi)]
-        )
-        acc = padded if acc is None else acc + padded
-        bounds[2 * i] += W2[i] * W2[i]
-        for j in range(i + 1, NLIMB):
-            bounds[i + j] += 2 * W2[i] * W2[j]
-    assert all(bv < 2**31 for bv in bounds)
+    """a^2 mod p: Karatsuba over the squaring convolution (three half
+    squares; diagonals once, cross terms doubled)."""
+    h = _KARA_LO
+    alo, ahi = a[:h], a[h:]
+    wlo, whi = W2[:h], W2[h:]
+    z0, b0 = _sqr_rows(alo, wlo, h)
+    z2, b2 = _sqr_rows(ahi, whi, h)
+    asum = alo + ahi
+    S = None
+    a2 = asum * 2
+    for i in range(h):
+        hi = h - i - 1
+        diag = asum[i : i + 1] * asum[i : i + 1]
+        row = _cat_rows([diag, asum[i] * a2[i + 1 : h]]) if hi else diag
+        padded = _pad_rows(row, 2 * i, 2 * h - 1 - 2 * i - 1 - hi)
+        S = padded if S is None else S + padded
+    z1b = _cross_bounds(W2, W2, h)
+    acc, bounds = _kara_combine(z0, b0, z2, b2, S, z1b, h, 2 * NLIMB - 1)
     return _settle(acc, bounds)
 
 

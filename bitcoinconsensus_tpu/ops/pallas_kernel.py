@@ -53,6 +53,7 @@ from .curve import (
     jacobian_add_flagged,
     jacobian_double,
     jacobian_madd_flagged,
+    jacobian_madd_flagged_ratio,
 )
 from .curve import _BETA_LIMBS, _GX_LIMBS, _GY_LIMBS, _ONE, _digits128
 from .limbs import (
@@ -67,6 +68,7 @@ from .limbs import (
     fe_inv_chain,
     fe_is_zero,
     fe_mul,
+    fe_mul_small,
     fe_sqr,
     fe_sqrt_chain,
     fe_sub,
@@ -183,13 +185,13 @@ def _kernel(
     ok_ref,
     tx_ref,
     ty_ref,
-    tz_ref,
 ):
     """One LANE_TILE-wide verify tile, entirely in VMEM.
 
     flags rows: 0=want_odd, 1=parity_req, 2=has_t2, 3=valid, 4=neg1,
     5=neg2. db/ds: signed-window digit magnitudes/signs (26, tile).
-    tx/ty/tz: (16, 20, tile) VMEM scratch for the per-lane {1..16}·P table.
+    tx/ty: (16, 20, tile) VMEM scratch for the global-Z-affine
+    {1..16}·P table.
     """
 
     def provider(arr):
@@ -203,7 +205,7 @@ def _kernel(
     try:
         _kernel_body(
             px_ref, t1_ref, t1n_ref, da_ref, db1_ref, ds1_ref, db2_ref,
-            ds2_ref, flags_ref, gx_ref, gy_ref, ok_ref, tx_ref, ty_ref, tz_ref,
+            ds2_ref, flags_ref, gx_ref, gy_ref, ok_ref, tx_ref, ty_ref,
         )
     finally:
         set_const_provider(prev)
@@ -224,7 +226,6 @@ def _kernel_body(
     ok_ref,
     tx_ref,
     ty_ref,
-    tz_ref,
 ):
     px = px_ref[:]
     want_odd = flags_ref[0, :]
@@ -252,31 +253,53 @@ def _kernel_body(
     px = jnp.where(valid[None], px, gxb)
     py = jnp.where(valid[None], py, gyb)
 
-    # -- per-lane Jacobian table {1..16}·P into VMEM scratch ------------
-    # (fori_loop + dynamic scratch store; Mosaic cannot lower a scan with
-    # per-step stacked outputs.) Row r holds (r+1)·P — signed digits never
-    # select zero (handled by the add's zero-mask), so no infinity row.
-    # Row 1 (2P) is an explicit doubling; the remaining rows use the
-    # FLAGGED mixed add (no embedded doubling fallback — kP == ±P is
-    # impossible for 2 <= k <= 15, the flag is folded defensively).
+    # -- per-lane table {1..16}·P, renormalized to a GLOBAL Z -----------
+    # Row r holds (r+1)·P. Build is Jacobian (row 1 = explicit doubling,
+    # rows 2..15 = FLAGGED mixed adds — kP == ±P is impossible for
+    # 2 <= k <= 16, the flag is folded defensively), recording each
+    # step's Z-ratio (Z_k = Z_{k-1} * ratio_k) in registers. A
+    # multiplication-only walk then rescales every row to
+    # the LAST row's Z — the reference's effective-affine/global-Z trick
+    # (`ecmult_impl.h:61-136` + `secp256k1_ge_table_set_globalz`): the
+    # whole window loop below runs on the isomorphic curve where the
+    # table is AFFINE (mixed adds, no Z selects), and the result returns
+    # to the true curve with ONE multiplication of its Z by global-Z.
+    # (The a=0 double/add formulas never reference the curve constant, so
+    # they are valid verbatim on the isomorphic curve.)
     ones = _const_col(_ONE, px)
-    tx_ref[0], ty_ref[0], tz_ref[0] = px, py, ones
-    p2 = jacobian_double(px, py, ones)
-    tx_ref[1], ty_ref[1], tz_ref[1] = p2
     zero_i = jnp.zeros(px.shape[1:], dtype=jnp.int32)
+    needs32 = zero_i
+    # Statically-unrolled build (no dynamic VMEM indexing — Mosaic lowers
+    # it poorly): rows go straight to scratch; only the 15 Z-ratios ride
+    # registers.
+    tx_ref[0], ty_ref[0] = px, py
+    ratios = [None, fe_mul_small(py, 2)]  # Z_1 = 2*py*1 (Z_0 = 1)
+    X, Y, Z = jacobian_double(px, py, ones)
+    tx_ref[1], ty_ref[1] = X, Y
+    for k in range(2, 16):
+        X, Y, Z, _inf, ndbl, ratio = jacobian_madd_flagged_ratio(
+            X, Y, Z, px, py, inf1=False
+        )
+        tx_ref[k], ty_ref[k] = X, Y
+        ratios.append(ratio)
+        needs32 = needs32 | ndbl.astype(jnp.int32)
 
-    def tstep(k, carry):
-        X, Y, Z, nh = carry
-        X, Y, Z, _inf, ndbl = jacobian_madd_flagged(X, Y, Z, px, py, inf1=False)
-        tx_ref[k], ty_ref[k], tz_ref[k] = X, Y, Z
-        return X, Y, Z, nh | ndbl.astype(jnp.int32)
-
-    *_tbl, needs32 = lax.fori_loop(2, 16, tstep, p2 + (zero_i,))
-    TX, TY, TZ = tx_ref[:], ty_ref[:], tz_ref[:]
+    # Rescale rows 14..0 to row 15's Z: c_k = prod_{j=k+1..15} ratio_j;
+    # global-Z = c after the walk absorbs ratio_1 (= Z_15).
+    c = None
+    for k in range(14, -1, -1):
+        c = ratios[k + 1] if c is None else fe_mul(c, ratios[k + 1])
+        c2 = fe_sqr(c)
+        tx_ref[k] = fe_mul(tx_ref[k], c2)
+        ty_ref[k] = fe_mul(ty_ref[k], fe_mul(c2, c))
+    global_z = c
+    TX, TY = tx_ref[:], ty_ref[:]
 
     # -- (±b1 ± lambda·b2)·P: 26 signed 5-bit windows of 5 doublings + 2
-    # complete adds (lambda*(x,y) = (beta*x, y); digit signs xor the GLV
-    # half signs and negate the selected y).
+    # mixed adds against the global-Z-affine table (lambda*(x,y) =
+    # (beta*x, y); digit signs xor the GLV half signs and negate the
+    # selected y; zero digits keep R via the same select pattern as the
+    # G loop).
     k16 = jax.lax.broadcasted_iota(jnp.int32, (16, 1, 1), 0) + 1
     beta = jnp.broadcast_to(
         _const_col(_BETA_LIMBS, px)[:, :1], px.shape
@@ -284,9 +307,21 @@ def _kernel_body(
 
     # Infinity and needs-host masks ride the fori_loop carries as int32
     # 0/1 — Mosaic cannot lower i1 vectors through loop boundaries.
+    def madd_step(R, r_inf32, nh, d, sign, selx, sely):
+        sely = jnp.where(
+            sign == 1, fe_sub(jnp.zeros_like(sely), sely), sely
+        )
+        Xa, Ya, Za, inf_a, nd = jacobian_madd_flagged(
+            *R, selx, sely, inf1=r_inf32 == 1
+        )
+        app = d > 0
+        out = _select(app, (Xa, Ya, Za), R)
+        r_inf32 = jnp.where(app, inf_a.astype(jnp.int32), r_inf32)
+        nh = nh | jnp.where(app, nd.astype(jnp.int32), 0)
+        return out, r_inf32, nh
+
     def wbody(i, carry):
         X, Y, Z, r_inf32, nh = carry
-        r_inf = r_inf32 == 1
         R = (X, Y, Z)
         w = SGLV_WINDOWS - 1 - i
         R = jacobian_double(*R)  # doublings preserve infinity
@@ -299,29 +334,23 @@ def _kernel_body(
         oh = (d1[None, None, :] == k16).astype(jnp.int32)  # (16, 1, T)
         selx = jnp.sum(TX * oh, axis=0)
         sely = jnp.sum(TY * oh, axis=0)
-        selz = jnp.sum(TZ * oh, axis=0)
-        sely = jnp.where(s1 == 1, fe_sub(jnp.zeros_like(sely), sely), sely)
-        *R, r_inf, nd1 = jacobian_add_flagged(
-            *R, selx, sely, selz, d1 == 0, inf1=r_inf
-        )
+        R, r_inf32, nh = madd_step(R, r_inf32, nh, d1, s1, selx, sely)
         d2 = db2_ref[w]
         s2 = (ds2_ref[w] ^ neg2i)[None]
         oh = (d2[None, None, :] == k16).astype(jnp.int32)
         selx = fe_mul(jnp.sum(TX * oh, axis=0), beta)
         sely = jnp.sum(TY * oh, axis=0)
-        selz = jnp.sum(TZ * oh, axis=0)
-        sely = jnp.where(s2 == 1, fe_sub(jnp.zeros_like(sely), sely), sely)
-        X, Y, Z, r_inf, nd2 = jacobian_add_flagged(
-            *R, selx, sely, selz, d2 == 0, inf1=r_inf
-        )
-        nh = nh | nd1.astype(jnp.int32) | nd2.astype(jnp.int32)
-        return X, Y, Z, r_inf.astype(jnp.int32), nh
+        R, r_inf32, nh = madd_step(R, r_inf32, nh, d2, s2, selx, sely)
+        return R + (r_inf32, nh)
 
     all_inf = jnp.ones(px.shape[1:], dtype=jnp.int32)
     X, Y, Z, r_inf32, needs32 = lax.fori_loop(
         0, SGLV_WINDOWS, wbody, _inf_like(px) + (all_inf, needs32)
     )
     r_inf = r_inf32 == 1
+    # Leave the isomorphic frame: true Z = Z * global-Z (infinity lanes
+    # stay Z = 0; flagged lanes carry garbage that the needs mask hides).
+    Z = fe_mul(Z, global_z)
     R = (X, Y, Z)
 
     # -- a·G: 32 windows, MXU one-hot row select against the VMEM table -
@@ -465,7 +494,6 @@ def verify_tiles(
         scratch_shapes=[
             pltpu.VMEM((16, NLIMB, tile), jnp.int32),  # P-table x
             pltpu.VMEM((16, NLIMB, tile), jnp.int32),  # P-table y
-            pltpu.VMEM((16, NLIMB, tile), jnp.int32),  # P-table z
         ],
         interpret=interpret,
     )(px, t1, t1n, da, db1, ds1, db2, ds2, flags, consts, gx, gy)

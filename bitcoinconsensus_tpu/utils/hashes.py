@@ -17,6 +17,7 @@ import hashlib
 import struct
 
 __all__ = [
+    "murmur3_32",
     "sha256",
     "sha256d",
     "hash160",
@@ -25,6 +26,43 @@ __all__ = [
     "tagged_hash",
     "tagged_hash_midstate_engine",
 ]
+
+
+def murmur3_32(seed: int, data: bytes) -> int:
+    """MurmurHash3 x86_32 (hash.cpp:16-78) — the last compiled-surface
+    hash of the reference crate (Core's bloom filters); vectors from
+    src/test/hash_tests.cpp asserted in tests/test_core_basics.py."""
+    c1, c2 = 0xCC9E2D51, 0x1B873593
+    M = 0xFFFFFFFF
+    h1 = seed & M
+    nblocks = len(data) // 4
+    for i in range(nblocks):
+        k1 = int.from_bytes(data[4 * i : 4 * i + 4], "little")
+        k1 = k1 * c1 & M
+        k1 = (k1 << 15 | k1 >> 17) & M
+        k1 = k1 * c2 & M
+        h1 ^= k1
+        h1 = (h1 << 13 | h1 >> 19) & M
+        h1 = (h1 * 5 + 0xE6546B64) & M
+    tail = data[nblocks * 4 :]
+    k1 = 0
+    if len(tail) >= 3:
+        k1 ^= tail[2] << 16
+    if len(tail) >= 2:
+        k1 ^= tail[1] << 8
+    if len(tail) >= 1:
+        k1 ^= tail[0]
+        k1 = k1 * c1 & M
+        k1 = (k1 << 15 | k1 >> 17) & M
+        k1 = k1 * c2 & M
+        h1 ^= k1
+    h1 ^= len(data)
+    h1 ^= h1 >> 16
+    h1 = h1 * 0x85EBCA6B & M
+    h1 ^= h1 >> 13
+    h1 = h1 * 0xC2B2AE35 & M
+    h1 ^= h1 >> 16
+    return h1
 
 
 def sha256(data: bytes) -> bytes:

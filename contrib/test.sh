@@ -12,10 +12,16 @@ else
     echo "== lint skipped (ruff not installed)"
 fi
 
-echo "== tests (CPU backend, 8 virtual devices via tests/conftest.py)"
-python -m pytest tests/ -x -q "$@"
+echo "== consensus core (CPU backend; fast marker)"
+python -m pytest tests/ -x -q -m consensus "$@"
+
+echo "== kernel families (big compiles)"
+python -m pytest tests/ -x -q -m kernel "$@"
 
 echo "== multichip dryrun (virtual 8-device CPU mesh)"
 python -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
+
+echo "== fuzz (sanitized, 30 s; fuzz/run.sh for longer)"
+bash fuzz/run.sh 30
 
 echo "== all green"

@@ -1,0 +1,55 @@
+#!/usr/bin/env bash
+# Time-boxed coverage-guided fuzz run over the native core, sanitized.
+# Usage: fuzz/run.sh [seconds (default 60)]
+#
+# Builds fuzz_nat with ASAN+UBSAN + -fsanitize-coverage=trace-pc, dumps a
+# seed corpus from the consensus test vectors, and runs the in-process
+# mutation loop. Any crash/divergence aborts (nonzero exit). CI runs this
+# with a short budget; leave it running longer locally for depth.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+SECS="${1:-60}"
+BUILD=fuzz/build
+mkdir -p "$BUILD/seeds"
+
+# Seed corpus: valid/invalid txs + a block + verify-shaped inputs, drawn
+# from the repo's own fixtures (deterministic).
+python - <<'EOF'
+import os, sys
+sys.path.insert(0, ".")
+out = "fuzz/build/seeds"
+from bitcoinconsensus_tpu.utils.blockgen import build_block, build_spend_tx, make_funded_view
+
+_, funded = make_funded_view(4, kinds=("p2wpkh", "p2tr", "p2wsh_multisig"), seed="fuzz")
+tx = build_spend_tx(funded, fee=700)
+raw = tx.serialize()
+blk = build_block([tx], 710_000, fees=700)
+open(f"{out}/tx", "wb").write(b"\x00" + raw)
+open(f"{out}/block", "wb").write(b"\x01" + blk.serialize())
+spk = funded[0].wallet.spk
+head = bytes([2]) + b"\x11\x08\x10\x20" + bytes([len(spk)]) + spk
+open(f"{out}/verify", "wb").write(head + raw)
+# transport-error shapes
+open(f"{out}/trunc", "wb").write(b"\x00" + raw[:17])
+open(f"{out}/empty", "wb").write(b"\x02\x00\x00\x00\x00\x00")
+print("seeds written")
+EOF
+
+# Two-step build: only the LIBRARY under test is edge-instrumented; the
+# engine itself (incl. __sanitizer_cov_trace_pc) must not be, or the
+# callback recurses into its own instrumentation.
+g++ -O1 -std=c++17 -g -c \
+    -fsanitize=address,undefined -fno-sanitize-recover=all \
+    -fsanitize-coverage=trace-pc \
+    native/nat.cpp -o "$BUILD/nat_cov.o"
+g++ -O1 -std=c++17 -g -c \
+    -fsanitize=address,undefined -fno-sanitize-recover=all \
+    fuzz/fuzz_nat.cpp -o "$BUILD/fuzz_nat.o"
+g++ -fsanitize=address,undefined \
+    "$BUILD/fuzz_nat.o" "$BUILD/nat_cov.o" -o "$BUILD/fuzz_nat"
+
+ASAN_OPTIONS="detect_leaks=0:abort_on_error=1" \
+UBSAN_OPTIONS="print_stacktrace=1:halt_on_error=1" \
+    "$BUILD/fuzz_nat" "$SECS" "$BUILD/seeds"
+echo "fuzz: clean"

@@ -459,6 +459,11 @@ inline i32 block_accounting(NBlock& blk, const NView& view, i64 height,
                             u32 flags) {
     BlockAcct& A = blk.acct;
     A = BlockAcct();
+    // The production driver runs check_block first (which rejects empty
+    // blocks with bad-blk-length), but this entry is independently
+    // reachable through the C ABI — the coinbase-cap read below must not
+    // index an empty vtx (found by fuzz/fuzz_nat.cpp on its seed corpus).
+    if (blk.vtx.empty()) return BR_BAD_LENGTH;
     std::unordered_map<std::string, NCoin> overlay;
     std::unordered_set<std::string> spent_keys;
 

@@ -151,4 +151,45 @@ inline void sha1(const u8* data, size_t len, u8 out[20]) {
         for (int j = 0; j < 4; j++) out[4 * i + j] = u8(h[i] >> (24 - 8 * j));
 }
 
+// MurmurHash3 x86_32 (hash.cpp:16-78 — compiled crate surface, used by
+// Core's bloom filters; unused by the verify path but part of drop-in
+// completeness). Standard smhasher algorithm; values asserted against the
+// reference implementation's outputs in tests/test_core_basics.py.
+inline u32 murmur3_32(u32 seed, const u8* data, size_t len) {
+    u32 h1 = seed;
+    const u32 c1 = 0xcc9e2d51u, c2 = 0x1b873593u;
+    auto rotl = [](u32 x, int r) { return (x << r) | (x >> (32 - r)); };
+    size_t nblocks = len / 4;
+    for (size_t i = 0; i < nblocks; i++) {
+        const u8* p = data + i * 4;
+        u32 k1 = (u32)p[0] | ((u32)p[1] << 8) | ((u32)p[2] << 16) |
+                 ((u32)p[3] << 24);
+        k1 *= c1;
+        k1 = rotl(k1, 15);
+        k1 *= c2;
+        h1 ^= k1;
+        h1 = rotl(h1, 13);
+        h1 = h1 * 5 + 0xe6546b64u;
+    }
+    const u8* tail = data + nblocks * 4;
+    u32 k1 = 0;
+    switch (len & 3) {
+        case 3: k1 ^= (u32)tail[2] << 16; [[fallthrough]];
+        case 2: k1 ^= (u32)tail[1] << 8; [[fallthrough]];
+        case 1:
+            k1 ^= tail[0];
+            k1 *= c1;
+            k1 = rotl(k1, 15);
+            k1 *= c2;
+            h1 ^= k1;
+    }
+    h1 ^= (u32)len;
+    h1 ^= h1 >> 16;
+    h1 *= 0x85ebca6bu;
+    h1 ^= h1 >> 13;
+    h1 *= 0xc2b2ae35u;
+    h1 ^= h1 >> 16;
+    return h1;
+}
+
 }  // namespace nat

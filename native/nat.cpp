@@ -409,6 +409,10 @@ unsigned int bitcoinconsensus_version() {
     return 1;  // BITCOINCONSENSUS_API_VER (bitcoinconsensus.h:36)
 }
 
+unsigned int nat_murmur3_32(unsigned int seed, const u8* data, i64 len) {
+    return murmur3_32(seed, data, (size_t)len);
+}
+
 void nat_sha256(const u8* data, i64 len, u8* out32) {
     sha256(data, (size_t)len, out32);
 }
@@ -780,6 +784,17 @@ void nat_tx_wtxid(void* txp, u8* out32) {
 }
 
 void nat_tx_free(void* tx) { delete static_cast<NTx*>(tx); }
+
+// Serialization export (fuzz harness + consumers needing the canonical
+// bytes): two-call pattern — size, then fill.
+i64 nat_tx_serialize_size(void* txp, i32 witness) {
+    return (i64)static_cast<NTx*>(txp)->serialize(witness != 0).size();
+}
+
+void nat_tx_serialize(void* txp, i32 witness, u8* out) {
+    Bytes b = static_cast<NTx*>(txp)->serialize(witness != 0);
+    std::memcpy(out, b.data(), b.size());
+}
 
 i64 nat_tx_ser_size(void* tx) { return static_cast<NTx*>(tx)->ser_size; }
 

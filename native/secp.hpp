@@ -24,6 +24,20 @@
 
 #include "sha256.hpp"
 
+// Portability contract (documented non-goal, VERDICT r4 §9): this core
+// requires unsigned __int128 (the 4x64 representation's 64x64->128
+// multiply) and a little-endian host. The reference additionally ships
+// 10x26/8x32 and big-endian (s390x) paths because it targets arbitrary
+// consumers; TPU hosts are x86-64/aarch64 little-endian, so instead of
+// carrying an untested fallback we make the assumption fail loudly at
+// compile time.
+#if !defined(__SIZEOF_INT128__)
+#error "native/secp.hpp requires unsigned __int128 (64-bit compiler)"
+#endif
+#if defined(__BYTE_ORDER__) && (__BYTE_ORDER__ != __ORDER_LITTLE_ENDIAN__)
+#error "native/secp.hpp requires a little-endian host (TPU hosts are LE)"
+#endif
+
 namespace nat {
 
 using u128 = unsigned __int128;

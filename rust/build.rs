@@ -1,0 +1,36 @@
+// Build the native consensus core (../native) and link it.
+//
+// The library is the same single-translation-unit g++ build the Python
+// bridge performs (bitcoinconsensus_tpu/native_bridge.py _build); set
+// BITCOINCONSENSUS_NAT_SO to an existing libnat.so to skip compilation.
+
+use std::env;
+use std::path::PathBuf;
+use std::process::Command;
+
+fn main() {
+    let out_dir = PathBuf::from(env::var("OUT_DIR").unwrap());
+    let manifest = PathBuf::from(env::var("CARGO_MANIFEST_DIR").unwrap());
+    let native = manifest.parent().unwrap().join("native");
+
+    if let Ok(so) = env::var("BITCOINCONSENSUS_NAT_SO") {
+        let so = PathBuf::from(so);
+        let dir = so.parent().unwrap();
+        println!("cargo:rustc-link-search=native={}", dir.display());
+        println!("cargo:rustc-link-lib=dylib=nat");
+        return;
+    }
+
+    let so = out_dir.join("libnat.so");
+    let status = Command::new(env::var("CXX").unwrap_or_else(|_| "g++".into()))
+        .args(["-O3", "-std=c++17", "-fPIC", "-shared"])
+        .arg(native.join("nat.cpp"))
+        .arg("-o")
+        .arg(&so)
+        .status()
+        .expect("g++ not found (required to build the native core)");
+    assert!(status.success(), "native core build failed");
+    println!("cargo:rustc-link-search=native={}", out_dir.display());
+    println!("cargo:rustc-link-lib=dylib=nat");
+    println!("cargo:rerun-if-changed={}", native.display());
+}

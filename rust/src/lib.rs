@@ -1,0 +1,253 @@
+//! Drop-in `bitcoinconsensus` API over the TPU framework's native core.
+//!
+//! The upstream crate (`rust-bitcoinconsensus`, src/lib.rs:103-139) wraps
+//! the three `libbitcoinconsensus` exports; this crate exposes the same
+//! public surface — `verify`, `verify_with_flags`, `height_to_flags`,
+//! `version`, the `VERIFY_*` flag constants and the `Error` enum — linked
+//! against `libnat.so` (native/nat.cpp:199-227), whose exports are proven
+//! byte-compatible with the reference shared library by
+//! `tests/test_drop_in_abi.py`. A consumer of the upstream crate can
+//! switch the dependency and recompile; no call site changes.
+//!
+//! Verification here is the HOST-EXACT path (the native interpreter +
+//! 4x64 secp core). Batch/TPU acceleration lives behind the Python API
+//! (`bitcoinconsensus_tpu.models.batch`), which this C ABI cannot express
+//! — same stance as upstream, whose C library is also single-call.
+
+#![allow(non_camel_case_types)]
+
+use core::fmt;
+
+/// No script verification.
+pub const VERIFY_NONE: u32 = 0;
+/// Evaluate P2SH (BIP16) subscripts.
+pub const VERIFY_P2SH: u32 = 1 << 0;
+/// Enforce strict DER (BIP66) compliance.
+pub const VERIFY_DERSIG: u32 = 1 << 2;
+/// Enforce NULLDUMMY (BIP147).
+pub const VERIFY_NULLDUMMY: u32 = 1 << 4;
+/// Enable CHECKLOCKTIMEVERIFY (BIP65).
+pub const VERIFY_CHECKLOCKTIMEVERIFY: u32 = 1 << 9;
+/// Enable CHECKSEQUENCEVERIFY (BIP112).
+pub const VERIFY_CHECKSEQUENCEVERIFY: u32 = 1 << 10;
+/// Enable WITNESS (BIP141).
+pub const VERIFY_WITNESS: u32 = 1 << 11;
+/// Every flag the libconsensus interface accepts.
+pub const VERIFY_ALL: u32 = VERIFY_P2SH
+    | VERIFY_DERSIG
+    | VERIFY_NULLDUMMY
+    | VERIFY_CHECKLOCKTIMEVERIFY
+    | VERIFY_CHECKSEQUENCEVERIFY
+    | VERIFY_WITNESS;
+
+/// Mainnet soft-fork activation schedule -> script flags (the upstream
+/// crate's table, src/lib.rs:45-66; heights from Bitcoin Core).
+pub fn height_to_flags(height: u32) -> u32 {
+    let mut flags = VERIFY_NONE;
+    if height >= 173_805 {
+        flags |= VERIFY_P2SH;
+    }
+    if height >= 363_725 {
+        flags |= VERIFY_DERSIG;
+    }
+    if height >= 388_381 {
+        flags |= VERIFY_CHECKLOCKTIMEVERIFY;
+    }
+    if height >= 419_328 {
+        flags |= VERIFY_CHECKSEQUENCEVERIFY;
+    }
+    if height >= 481_824 {
+        flags |= VERIFY_NULLDUMMY | VERIFY_WITNESS;
+    }
+    flags
+}
+
+/// Errors of the libconsensus interface (bitcoinconsensus.h:38-46); the
+/// discriminants are the C enum's values, so the out-parameter can be
+/// written by the library directly.
+#[derive(Debug, Copy, Clone, PartialEq, Eq)]
+#[repr(C)]
+pub enum Error {
+    /// Script failed verification (also the out-parameter default).
+    ERR_SCRIPT = 0,
+    /// `input_index` out of range for the spending transaction.
+    ERR_TX_INDEX,
+    /// The spending transaction re-serialized to a different size.
+    ERR_TX_SIZE_MISMATCH,
+    /// The spending transaction failed to deserialize.
+    ERR_TX_DESERIALIZE,
+    /// WITNESS verification requires a spent amount.
+    ERR_AMOUNT_REQUIRED,
+    /// Flags outside the libconsensus interface.
+    ERR_INVALID_FLAGS,
+}
+
+impl fmt::Display for Error {
+    fn fmt(&self, f: &mut fmt::Formatter) -> fmt::Result {
+        f.write_str(match self {
+            Error::ERR_SCRIPT => "script failed verification",
+            Error::ERR_TX_INDEX => "input index out of range",
+            Error::ERR_TX_SIZE_MISMATCH => "serialized size mismatch",
+            Error::ERR_TX_DESERIALIZE => "transaction deserialization failed",
+            Error::ERR_AMOUNT_REQUIRED => "spent amount required for WITNESS",
+            Error::ERR_INVALID_FLAGS => "invalid verification flags",
+        })
+    }
+}
+
+#[cfg(feature = "std")]
+impl std::error::Error for Error {}
+
+pub mod ffi {
+    //! The raw C ABI (bitcoinconsensus.h:67-75, exported by libnat.so).
+    use super::Error;
+
+    extern "C" {
+        pub fn bitcoinconsensus_version() -> i32;
+        pub fn bitcoinconsensus_verify_script_with_amount(
+            script_pubkey: *const u8,
+            script_pubkey_len: u32,
+            amount: u64,
+            tx_to: *const u8,
+            tx_to_len: u32,
+            n_in: u32,
+            flags: u32,
+            err: *mut Error,
+        ) -> i32;
+    }
+}
+
+/// Library version (`bitcoinconsensus_version`).
+pub fn version() -> u32 {
+    unsafe { ffi::bitcoinconsensus_version() as u32 }
+}
+
+/// Verify that input `input_index` of `spending_transaction` correctly
+/// spends `spent_output` under [`VERIFY_ALL`].
+pub fn verify(
+    spent_output: &[u8],
+    amount: u64,
+    spending_transaction: &[u8],
+    input_index: usize,
+) -> Result<(), Error> {
+    verify_with_flags(spent_output, amount, spending_transaction, input_index, VERIFY_ALL)
+}
+
+/// [`verify`] with an explicit flag set.
+pub fn verify_with_flags(
+    spent_output_script: &[u8],
+    amount: u64,
+    spending_transaction: &[u8],
+    input_index: usize,
+    flags: u32,
+) -> Result<(), Error> {
+    let mut err = Error::ERR_SCRIPT;
+    let ok = unsafe {
+        ffi::bitcoinconsensus_verify_script_with_amount(
+            spent_output_script.as_ptr(),
+            spent_output_script.len() as u32,
+            amount,
+            spending_transaction.as_ptr(),
+            spending_transaction.len() as u32,
+            input_index as u32,
+            flags,
+            &mut err,
+        )
+    };
+    if ok == 1 {
+        Ok(())
+    } else {
+        Err(err)
+    }
+}
+
+#[cfg(test)]
+mod tests {
+    use super::*;
+
+    fn unhex(s: &str) -> Vec<u8> {
+        (0..s.len())
+            .step_by(2)
+            .map(|i| u8::from_str_radix(&s[i..i + 2], 16).unwrap())
+            .collect()
+    }
+
+    fn run(spent: &str, spending: &str, amount: u64, idx: usize) -> Result<(), Error> {
+        verify(&unhex(spent), amount, &unhex(spending), idx)
+    }
+
+    // The upstream crate's own end-to-end vectors (src/lib.rs:215-277):
+    // public mainnet transactions — P2PKH, P2SH-P2WPKH and P2WSH spends,
+    // plus their corrupted/wrong-amount negatives.
+    const P2PKH_SPENT: &str = "76a9144bfbaf6afb76cc5771bc6404810d1cc041a6933988ac";
+    const P2PKH_SPENDING: &str = "02000000013f7cebd65c27431a90bba7f796914fe8cc2ddfc3f2cbd6f7e5f2fc854534da95000000006b483045022100de1ac3bcdfb0332207c4a91f3832bd2c2915840165f876ab47c5f8996b971c3602201c6c053d750fadde599e6f5c4e1963df0f01fc0d97815e8157e3d59fe09ca30d012103699b464d1d8bc9e47d4fb1cdaa89a1c5783d68363c4dbc4b524ed3d857148617feffffff02836d3c01000000001976a914fc25d6d5c94003bf5b0c7b640a248e2c637fcfb088ac7ada8202000000001976a914fbed3d9b11183209a57999d54d59f67c019e756c88ac6acb0700";
+    const P2SHWPKH_SPENT: &str = "a91434c06f8c87e355e123bdc6dda4ffabc64b6989ef87";
+    const P2SHWPKH_SPENDING: &str = "01000000000101d9fd94d0ff0026d307c994d0003180a5f248146efb6371d040c5973f5f66d9df0400000017160014b31b31a6cb654cfab3c50567bcf124f48a0beaecffffffff012cbd1c000000000017a914233b74bf0823fa58bbbd26dfc3bb4ae715547167870247304402206f60569cac136c114a58aedd80f6fa1c51b49093e7af883e605c212bdafcd8d202200e91a55f408a021ad2631bc29a67bd6915b2d7e9ef0265627eabd7f7234455f6012103e7e802f50344303c76d12c089c8724c1b230e3b745693bbe16aad536293d15e300000000";
+    const P2WSH_SPENT: &str = "0020701a8d401c84fb13e6baf169d59684e17abd9fa216c8cc5b9fc63d622ff8c58d";
+    const P2WSH_SPENDING: &str = "010000000001011f97548fbbe7a0db7588a66e18d803d0089315aa7d4cc28360b6ec50ef36718a0100000000ffffffff02df1776000000000017a9146c002a686959067f4866b8fb493ad7970290ab728757d29f0000000000220020701a8d401c84fb13e6baf169d59684e17abd9fa216c8cc5b9fc63d622ff8c58d04004730440220565d170eed95ff95027a69b313758450ba84a01224e1f7f130dda46e94d13f8602207bdd20e307f062594022f12ed5017bbf4a055a06aea91c10110a0e3bb23117fc014730440220647d2dc5b15f60bc37dc42618a370b2a1490293f9e5c8464f53ec4fe1dfe067302203598773895b4b16d37485cbe21b337f4e4b650739880098c592553add7dd4355016952210375e00eb72e29da82b89367947f29ef34afb75e8654f6ea368e0acdfd92976b7c2103a1b26313f430c4b15bb1fdce663207659d8cac749a0e53d70eff01874496feff2103c96d495bfdd5ba4145e3e046fee45e84a8a48ad05bd8dbb395c011a32cf9f88053ae00000000";
+
+    #[test]
+    fn upstream_positive_vectors() {
+        run(P2PKH_SPENT, P2PKH_SPENDING, 0, 0).unwrap();
+        run(P2SHWPKH_SPENT, P2SHWPKH_SPENDING, 1_900_000, 0).unwrap();
+        run(P2WSH_SPENT, P2WSH_SPENDING, 18_393_430, 0).unwrap();
+    }
+
+    #[test]
+    fn upstream_negative_vectors() {
+        // wrong output script byte
+        let bad_spk = P2PKH_SPENT.replace("88ac", "88ff");
+        assert!(run(&bad_spk, P2PKH_SPENDING, 0, 0).is_err());
+        // wrong amount under WITNESS
+        assert!(run(P2SHWPKH_SPENT, P2SHWPKH_SPENDING, 900_000, 0).is_err());
+        // wrong witness program
+        let bad_wp = P2WSH_SPENT.replace("8c58d", "8c58f");
+        assert!(run(&bad_wp, P2WSH_SPENDING, 18_393_430, 0).is_err());
+    }
+
+    #[test]
+    fn invalid_flags() {
+        assert_eq!(
+            verify_with_flags(&[], 0, &[], 0, VERIFY_ALL + 1),
+            Err(Error::ERR_INVALID_FLAGS)
+        );
+    }
+
+    #[test]
+    fn error_codes() {
+        let spending = unhex(P2PKH_SPENDING);
+        assert_eq!(
+            verify(&unhex(P2PKH_SPENT), 0, &spending, 99),
+            Err(Error::ERR_TX_INDEX)
+        );
+        assert_eq!(
+            verify(&unhex(P2PKH_SPENT), 0, &[], 0),
+            Err(Error::ERR_TX_DESERIALIZE)
+        );
+        let mut trailing = spending.clone();
+        trailing.push(0);
+        assert_eq!(
+            verify(&unhex(P2PKH_SPENT), 0, &trailing, 0),
+            Err(Error::ERR_TX_SIZE_MISMATCH)
+        );
+    }
+
+    #[test]
+    fn height_schedule() {
+        assert_eq!(height_to_flags(0), VERIFY_NONE);
+        assert_eq!(height_to_flags(173_805), VERIFY_P2SH);
+        assert_eq!(height_to_flags(500_000), VERIFY_ALL);
+    }
+
+    #[test]
+    fn abi_version() {
+        assert_eq!(version(), 1); // BITCOINCONSENSUS_API_VER
+    }
+
+    #[test]
+    fn c_type_layout() {
+        // the upstream layout test (src/types.rs:19-24): the enum must be
+        // a C int so the out-parameter write is well-defined
+        assert_eq!(core::mem::size_of::<Error>(), core::mem::size_of::<i32>());
+    }
+}

@@ -206,3 +206,44 @@ class TestSighashScriptCodeSerializer:
         # Well-formed case: separators removed, size adjusted.
         sc3 = b"\x51\xab\x52\xab\x53"
         assert _serialize_script_code(sc3) == b"\x03\x51\x52\x53"
+
+
+def test_murmurhash3_reference_vectors():
+    """MurmurHash3 x86_32 vectors from the reference's own test suite
+    (src/test/hash_tests.cpp:29-43) — Python and native agree."""
+    from bitcoinconsensus_tpu.utils.hashes import murmur3_32
+    from bitcoinconsensus_tpu import native_bridge
+
+    vectors = [
+        (0x00000000, 0x00000000, ""),
+        (0x6A396F08, 0xFBA4C795, ""),
+        (0x81F16F39, 0xFFFFFFFF, ""),
+        (0x514E28B7, 0x00000000, "00"),
+        (0xEA3F0B17, 0xFBA4C795, "00"),
+        (0xFD6CF10D, 0x00000000, "ff"),
+        (0x16C6B7AB, 0x00000000, "0011"),
+        (0x8EB51C3D, 0x00000000, "001122"),
+        (0xB4471BF8, 0x00000000, "00112233"),
+        (0xE2301FA8, 0x00000000, "0011223344"),
+        (0xFC2E4A15, 0x00000000, "001122334455"),
+        (0xB074502C, 0x00000000, "00112233445566"),
+        (0x8034D2A0, 0x00000000, "0011223344556677"),
+        (0xB4698DEF, 0x00000000, "001122334455667788"),
+    ]
+    for expected, seed, hexdata in vectors:
+        data = bytes.fromhex(hexdata)
+        assert murmur3_32(seed, data) == expected, (seed, hexdata)
+        if native_bridge.available():
+            import ctypes
+            import numpy as np
+
+            arr = (
+                np.frombuffer(data, dtype=np.uint8)
+                if data
+                else np.zeros(1, np.uint8)
+            )
+            got = native_bridge.lib().nat_murmur3_32(
+                seed, arr.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+                len(data),
+            )
+            assert got == expected, (seed, hexdata)
