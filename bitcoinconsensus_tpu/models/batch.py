@@ -286,74 +286,102 @@ def _idx_threads() -> int:
     return max(1, os.cpu_count() or 1)
 
 
-def _accept_mask(resolved: np.ndarray, rec_idx: np.ndarray, bounds,
+class _UniqState:
+    """Per-fixpoint resolution state over the session's uniq list:
+    `val[i]` is entry i's verdict (every entry resolves in the round that
+    discovers it), `keys[i]` its salted sig-cache digest (kept so later
+    rounds never re-digest)."""
+
+    __slots__ = ("val", "keys")
+
+    def __init__(self):
+        self.val = np.zeros(0, dtype=bool)
+        self.keys: List[bytes] = []
+
+
+def _accept_mask(state: _UniqState, rec_idx: np.ndarray, bounds,
                  unk) -> np.ndarray:
     """Per-input acceptance after a resolve round: input k's verdict is
     exact when it had no oracle misses (unk == 0) or every miss resolved
-    TRUE (the optimistic assumption matched reality). Vectorized over the
-    rec_idx slices via one cumulative sum — the per-input Python loop this
-    replaces was ~10% of block-replay host time."""
+    TRUE (the optimistic assumption matched reality).
+    Vectorized over the rec_idx slices via one cumulative sum — the
+    per-input Python loop this replaces was ~10% of block-replay host
+    time."""
     unk = np.asarray(unk)
     out = unk == 0
     if len(rec_idx) and not out.all():
-        have = resolved[rec_idx].astype(np.int64)
+        have = state.val[rec_idx].astype(np.int64)
         b = np.asarray(bounds, dtype=np.int64)
         cs = np.concatenate([np.zeros(1, np.int64), np.cumsum(have)])
         out = out | ((cs[b[1:]] - cs[b[:-1]]) == (b[1:] - b[:-1]))
     return out
 
 
-def _resolve_uniq(nsess, verifier, sig_cache, resolved: np.ndarray) -> np.ndarray:
-    """Resolve every uniq entry the session discovered since the last call
-    (entries [len(resolved), uniq_count)): salted sig-cache probe first
-    (success-only skip, script/sigcache.cpp:22-122), then packed kernel
-    lanes prepped IN the session (no check bytes cross the bridge) and one
-    pipelined device dispatch per chunk; exceptional lanes flagged by the
-    fast device adds resolve exactly via nat_session_uniq_host_verify.
-    Verdicts are published straight into the native oracle. Returns the
-    grown 0/1 verdict array aligned with uniq indices."""
+def _resolve_uniq(nsess, verifier, sig_cache, state: _UniqState) -> None:
+    """Resolve uniq entries for one fixpoint round: salted sig-cache probe
+    first (success-only skip, script/sigcache.cpp:22-122), then packed
+    kernel lanes prepped IN the session (no check bytes cross the bridge)
+    and one pipelined device dispatch per chunk; exceptional lanes flagged
+    by the fast device adds resolve exactly via
+    nat_session_uniq_host_verify. Newly-known verdicts are published into
+    the native oracle.
+
+    Dispatch policy note: every unresolved entry resolves each round —
+    INCLUDING the speculative CHECKMULTISIG pairings no rec_idx
+    references. Deferring the speculative entries to a contingent second
+    dispatch was measured and rejected: Core's CHECKMULTISIG cursor walks
+    keys top-down (interpreter.cpp:1177-1205), so even a consensus-
+    ordered m-of-n spend guesses a FALSE pairing first and the
+    re-interpretation needs the pre-recorded pairings known — they are
+    the main verdict path, not insurance, and deferring them bought a
+    second 10k-lane device round-trip on the multisig benchmark."""
     U = nsess.uniq_count()
-    lo = len(resolved)
+    lo = len(state.val)
     if U == lo:
-        return resolved
-    idxs = np.arange(lo, U, dtype=np.int32)
+        return
+    grow = np.arange(lo, U, dtype=np.int32)
     with verifier.phases("host_prep"):
-        digs = nsess.uniq_digests(sig_cache._salt, idxs)
+        digs = nsess.uniq_digests(sig_cache._salt, grow)
     raw = digs.tobytes()
-    keys = [raw[32 * j : 32 * j + 32] for j in range(U - lo)]
-    new = np.zeros(U - lo, dtype=bool)
+    state.keys.extend(raw[32 * j : 32 * j + 32] for j in range(U - lo))
+    state.val = np.concatenate([state.val, np.zeros(U - lo, dtype=bool)])
+
+    newly: List[int] = []
     if len(sig_cache) == 0:  # cold cache: every probe misses
-        miss: List[int] = list(range(U - lo))
+        miss = [int(i) for i in grow]
     else:
         miss = []
-        for j, k in enumerate(keys):
-            if sig_cache.contains_key(k):
-                new[j] = True
+        for i in grow:
+            if sig_cache.contains_key(state.keys[int(i)]):
+                state.val[i] = True
+                newly.append(int(i))
             else:
-                miss.append(j)
+                miss.append(int(i))
     if miss:
         chunk = verifier.chunk
         pending = []
         for s in range(0, len(miss), chunk):
-            sub = miss[s : s + chunk]
-            sub_idx = idxs[sub]
+            sub = np.asarray(miss[s : s + chunk], dtype=np.int32)
             with verifier.phases("host_prep"):
-                lanes = nsess.uniq_lanes(sub_idx, verifier.pad(len(sub)))
-            pending.append((verifier.dispatch_lanes(lanes, len(sub)), sub_idx, sub))
-        for pend, sub_idx, sub in pending:
+                lanes = nsess.uniq_lanes(sub, verifier.pad(len(sub)))
+            pending.append((verifier.dispatch_lanes(lanes, len(sub)), sub))
+        for pend, sub in pending:
             okv, needs = verifier.sync_lanes(pend, len(sub))
             okv = np.array(okv, dtype=bool, copy=True)
             if needs is not None and needs.any():
                 for t in np.nonzero(needs)[0]:
-                    r = nsess.uniq_host_verify(int(sub_idx[t]))
+                    r = nsess.uniq_host_verify(int(sub[t]))
                     okv[t] = r
                     if not r:
                         verifier._fixup_failed = True
-            new[np.asarray(sub)] = okv
+            state.val[sub] = okv
+            newly.extend(int(i) for i in sub)
             for t in np.nonzero(okv)[0]:  # success-only, like the reference
-                sig_cache.add_key(keys[sub[int(t)]])
-    nsess.publish_uniq(idxs, new.astype(np.int32))
-    return np.concatenate([resolved, new])
+                sig_cache.add_key(state.keys[int(sub[int(t)])])
+
+    if newly:
+        ids = np.asarray(newly, dtype=np.int32)
+        nsess.publish_uniq(ids, state.val[ids].astype(np.int32))
 
 
 def run_idx_fixpoint(
@@ -374,16 +402,16 @@ def run_idx_fixpoint(
     inputs still pending at the round cap go through `exact_fallback(idx)
     -> (ok, err_code)`. Returns {input: (ok, script_err_code)}."""
     final: Dict[int, Tuple[bool, int]] = {}
-    resolved = np.zeros(0, dtype=bool)
+    state = _UniqState()
     pending = list(live)
     for _round in range(max_rounds):
         if not pending:
             break
         ok, err, unk, rec_idx, bounds = run_idx(pending)
-        resolved = _resolve_uniq(nsess, verifier, sig_cache, resolved)
+        _resolve_uniq(nsess, verifier, sig_cache, state)
         # exact verdict (unk == 0), or optimistic with every guess
         # confirmed true — equivalent to an exact pass
-        accept = _accept_mask(resolved, rec_idx, bounds, unk)
+        accept = _accept_mask(state, rec_idx, bounds, unk)
         still: List[int] = []
         for k, idx in enumerate(pending):
             if accept[k]:
