@@ -289,14 +289,12 @@ def _idx_threads() -> int:
 class _UniqState:
     """Per-fixpoint resolution state over the session's uniq list:
     `val[i]` is entry i's verdict (every entry resolves in the round that
-    discovers it), `keys[i]` its salted sig-cache digest (kept so later
-    rounds never re-digest)."""
+    discovers it, so the array is complete up to its length)."""
 
-    __slots__ = ("val", "keys")
+    __slots__ = ("val",)
 
     def __init__(self):
         self.val = np.zeros(0, dtype=bool)
-        self.keys: List[bytes] = []
 
 
 def _accept_mask(state: _UniqState, rec_idx: np.ndarray, bounds,
@@ -343,18 +341,16 @@ def _resolve_uniq(nsess, verifier, sig_cache, state: _UniqState) -> None:
     with verifier.phases("host_prep"):
         digs = nsess.uniq_digests(sig_cache._salt, grow)
     raw = digs.tobytes()
-    state.keys.extend(raw[32 * j : 32 * j + 32] for j in range(U - lo))
+    keys = {int(i): raw[32 * j : 32 * j + 32] for j, i in enumerate(grow)}
     state.val = np.concatenate([state.val, np.zeros(U - lo, dtype=bool)])
 
-    newly: List[int] = []
     if len(sig_cache) == 0:  # cold cache: every probe misses
         miss = [int(i) for i in grow]
     else:
         miss = []
         for i in grow:
-            if sig_cache.contains_key(state.keys[int(i)]):
+            if sig_cache.contains_key(keys[int(i)]):
                 state.val[i] = True
-                newly.append(int(i))
             else:
                 miss.append(int(i))
     if miss:
@@ -375,13 +371,10 @@ def _resolve_uniq(nsess, verifier, sig_cache, state: _UniqState) -> None:
                     if not r:
                         verifier._fixup_failed = True
             state.val[sub] = okv
-            newly.extend(int(i) for i in sub)
             for t in np.nonzero(okv)[0]:  # success-only, like the reference
-                sig_cache.add_key(state.keys[int(sub[int(t)])])
+                sig_cache.add_key(keys[int(sub[int(t)])])
 
-    if newly:
-        ids = np.asarray(newly, dtype=np.int32)
-        nsess.publish_uniq(ids, state.val[ids].astype(np.int32))
+    nsess.publish_uniq(grow, state.val[grow].astype(np.int32))
 
 
 def run_idx_fixpoint(

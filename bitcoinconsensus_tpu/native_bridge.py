@@ -41,7 +41,7 @@ _SO_PATH = os.path.join(_NATIVE_DIR, "libnat.so")
 _PACKAGED_SO = os.path.join(
     os.path.dirname(os.path.abspath(__file__)), "_native", "libnat.so"
 )
-_SOURCES = ("nat.cpp", "secp.hpp", "sha256.hpp", "hash_extra.hpp", "interp.hpp", "eval.hpp")
+_SOURCES = ("nat.cpp", "secp.hpp", "sha256.hpp", "hash_extra.hpp", "interp.hpp", "eval.hpp", "block.hpp")
 
 _lock = threading.Lock()
 _lib: Optional[ctypes.CDLL] = None
