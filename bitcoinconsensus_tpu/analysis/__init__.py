@@ -1,0 +1,20 @@
+"""Static analysis of the consensus kernels.
+
+`interval` — jaxpr-level interval abstract interpretation (the int32
+overflow prover) fused with the determinism/op-allowlist gate.
+`registry` — the kernels the prover must certify, with their input
+contracts. `host_lint` — AST lint of the plain-Python consensus path.
+
+Entry point: `scripts/consensus_lint.py` (also the CI `analysis` job).
+"""
+
+from .interval import (  # noqa: F401
+    ALLOWED_PRIMITIVES,
+    AbstractArray,
+    Report,
+    Violation,
+    analyze,
+    analyze_closed,
+)
+from .host_lint import LintFinding, lint_consensus_host, lint_paths  # noqa: F401
+from .registry import KernelSpec, all_kernels, get_kernel  # noqa: F401

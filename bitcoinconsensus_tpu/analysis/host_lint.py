@@ -1,0 +1,134 @@
+"""Host-side determinism lint for the consensus interpreter.
+
+The jaxpr prover covers traced kernels; this covers the plain-Python
+consensus path (`core/` — script interpreter, tx/block checks, sighash —
+and `models/` — batch orchestration whose decisions feed verdicts).
+Those modules must be bit-exact, replayable functions of their inputs:
+
+- no float literals or float arithmetic (script semantics are integer;
+  a float sneaking into, say, a fee or size comparison is a consensus
+  fault that no test vector may cover),
+- no `random` / `secrets` (verdicts must not depend on entropy),
+- no reading clocks (`time.time`, `datetime.now`, `time.monotonic` —
+  anything time-dependent belongs to policy, not consensus).
+
+Pure-AST checks: no imports of the scanned modules, so a syntax-valid
+file is lintable even when its dependencies are not importable.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from dataclasses import dataclass
+from typing import Iterator, List, Sequence
+
+BANNED_IMPORTS = {"random", "secrets"}
+# module.attr calls whose mere presence is a violation
+BANNED_CALLS = {
+    ("time", "time"), ("time", "time_ns"), ("time", "monotonic"),
+    ("time", "monotonic_ns"), ("time", "perf_counter"),
+    ("time", "perf_counter_ns"), ("time", "process_time"),
+    ("datetime", "now"), ("datetime", "utcnow"), ("datetime", "today"),
+    ("date", "today"),
+}
+FLOAT_CAST = {"float"}
+
+
+@dataclass
+class LintFinding:
+    path: str
+    line: int
+    rule: str
+    msg: str
+
+    def __str__(self):
+        return f"{self.path}:{self.line}: [{self.rule}] {self.msg}"
+
+
+def _is_float_literal(node: ast.Constant) -> bool:
+    return isinstance(node.value, float)
+
+
+class _Visitor(ast.NodeVisitor):
+    def __init__(self, path: str):
+        self.path = path
+        self.findings: List[LintFinding] = []
+
+    def _flag(self, node, rule, msg):
+        self.findings.append(
+            LintFinding(self.path, getattr(node, "lineno", 0), rule, msg))
+
+    def visit_Constant(self, node: ast.Constant):
+        if _is_float_literal(node):
+            self._flag(node, "float-literal",
+                       f"float literal {node.value!r} in consensus host "
+                       "code (integer semantics only)")
+        self.generic_visit(node)
+
+    def visit_Import(self, node: ast.Import):
+        for alias in node.names:
+            root = alias.name.split(".")[0]
+            if root in BANNED_IMPORTS:
+                self._flag(node, "nondeterminism",
+                           f"import of `{alias.name}` (entropy source)")
+        self.generic_visit(node)
+
+    def visit_ImportFrom(self, node: ast.ImportFrom):
+        root = (node.module or "").split(".")[0]
+        if root in BANNED_IMPORTS:
+            self._flag(node, "nondeterminism",
+                       f"import from `{node.module}` (entropy source)")
+        self.generic_visit(node)
+
+    def visit_Call(self, node: ast.Call):
+        fn = node.func
+        if isinstance(fn, ast.Attribute) and isinstance(fn.value, ast.Name):
+            key = (fn.value.id, fn.attr)
+            if key in BANNED_CALLS:
+                self._flag(node, "time-dependence",
+                           f"call to {key[0]}.{key[1]}() — consensus "
+                           "verdicts must not read clocks")
+        if isinstance(fn, ast.Name) and fn.id in FLOAT_CAST:
+            self._flag(node, "float-op",
+                       "float() cast in consensus host code")
+        self.generic_visit(node)
+
+    def visit_BinOp(self, node: ast.BinOp):
+        if isinstance(node.op, ast.Div):
+            self._flag(node, "float-op",
+                       "true division `/` yields float; use `//` for "
+                       "integer consensus arithmetic")
+        self.generic_visit(node)
+
+
+def _iter_py(root: str) -> Iterator[str]:
+    for dirpath, _dirnames, filenames in os.walk(root):
+        for f in sorted(filenames):
+            if f.endswith(".py"):
+                yield os.path.join(dirpath, f)
+
+
+def lint_paths(paths: Sequence[str]) -> List[LintFinding]:
+    findings: List[LintFinding] = []
+    for root in paths:
+        files = _iter_py(root) if os.path.isdir(root) else [root]
+        for path in files:
+            with open(path, "r", encoding="utf-8") as fh:
+                src = fh.read()
+            try:
+                tree = ast.parse(src, filename=path)
+            except SyntaxError as e:
+                findings.append(LintFinding(path, e.lineno or 0,
+                                            "syntax", str(e)))
+                continue
+            v = _Visitor(path)
+            v.visit(tree)
+            findings.extend(v.findings)
+    return findings
+
+
+def lint_consensus_host(repo_root: str) -> List[LintFinding]:
+    pkg = os.path.join(repo_root, "bitcoinconsensus_tpu")
+    return lint_paths([os.path.join(pkg, "core"),
+                       os.path.join(pkg, "models")])

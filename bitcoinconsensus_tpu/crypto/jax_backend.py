@@ -407,7 +407,7 @@ class TpuSecpVerifier:
         lanes = [_Lane() for _ in checks]
         ecdsa_pending = []  # (lane, r, s, m)
         schnorr_pending = []  # (lane, r32, px32, m32) — device-challenge mode
-        for lane, chk in zip(lanes, checks):
+        for lane, chk in zip(lanes, checks, strict=True):
             if chk.kind == "ecdsa":
                 got = _prep_ecdsa(lane, *chk.data)
                 if got is not None:
@@ -422,7 +422,7 @@ class TpuSecpVerifier:
                 _prep_tweak(lane, *chk.data)
         if ecdsa_pending:
             sinvs = _batch_inv_mod_n([s for _, _, s, _ in ecdsa_pending])
-            for (lane, r, _s, m), sinv in zip(ecdsa_pending, sinvs):
+            for (lane, r, _s, m), sinv in zip(ecdsa_pending, sinvs, strict=True):
                 lane.a = m * sinv % N  # u1
                 lane.set_b(r * sinv % N)  # u2
         if schnorr_pending:
@@ -441,7 +441,7 @@ class TpuSecpVerifier:
             digests = np.asarray(
                 bip340_challenge(stack[:, :32], stack[:, 32:64], stack[:, 64:])
             )
-            for (lane, *_), d in zip(schnorr_pending, digests):
+            for (lane, *_), d in zip(schnorr_pending, digests, strict=True):
                 e = int.from_bytes(d.tobytes(), "big") % N
                 lane.set_b((N - e) % N)  # (n-e)·P = -e·P
         return lanes

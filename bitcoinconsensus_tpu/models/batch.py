@@ -532,7 +532,7 @@ def _verify_batch_impl(
     script_keys: List[Optional[bytes]] = [None] * len(items)
     probe_idx: List[int] = []
     probe_parts: List[Tuple[bytes, ...]] = []
-    for idx, (item, prep) in enumerate(zip(items, preps)):
+    for idx, (item, prep) in enumerate(zip(items, preps, strict=True)):
         if prep.result is not None or prep.wtxid is None:
             continue
         if item.spent_outputs is not None:
@@ -547,7 +547,8 @@ def _verify_batch_impl(
                 prep.wtxid, item.input_index, item.flags, digest
             )
         )
-    for idx, key in zip(probe_idx, script_cache.keys_for_parts(probe_parts)):
+    for idx, key in zip(probe_idx, script_cache.keys_for_parts(probe_parts),
+                        strict=True):
         script_keys[idx] = key
         if script_cache.contains_key(key):
             preps[idx].result = BatchResult.success()
@@ -604,7 +605,7 @@ def _verify_batch_impl(
         for j, idx in enumerate(native_idx):
             preps[idx].optimistic = (bool(ok_a[j]), ScriptError(int(err_a[j])))
             preps[idx].checks = [SigCheck(k, d) for k, d in recs[j]]
-    for item, prep in zip(items, preps):
+    for item, prep in zip(items, preps, strict=True):
         if prep.result is not None or prep.ntx is not None:
             continue
         ok, err, _unk, checks = interpret_deferring(item, prep)
@@ -652,14 +653,14 @@ def _verify_batch_impl(
         if todo:
             cache_keys = sig_cache.keys_for_checks(todo)
             fresh: List[Tuple[SigCheck, bytes]] = []
-            for chk, ck in zip(todo, cache_keys):
+            for chk, ck in zip(todo, cache_keys, strict=True):
                 if sig_cache.contains_key(ck):
                     known[(chk.kind, chk.data)] = True
                 else:
                     fresh.append((chk, ck))
             if fresh:
                 run_res = verifier.verify_checks([c for c, _ in fresh])
-                for (chk, ck), r in zip(fresh, run_res):
+                for (chk, ck), r in zip(fresh, run_res, strict=True):
                     known[(chk.kind, chk.data)] = bool(r)
                     if r:  # success-only insertion, like the reference
                         sig_cache.add_key(ck)
@@ -743,7 +744,7 @@ def _verify_batch_impl(
         )
 
     out: List[BatchResult] = []
-    for idx, (item, prep) in enumerate(zip(items, preps)):
+    for idx, (_item, prep) in enumerate(zip(items, preps, strict=True)):
         if prep.result is not None:
             out.append(prep.result)
             continue

@@ -141,14 +141,14 @@ def get_transaction_sigop_cost(
         return cost
     if flags & VERIFY_P2SH:
         p2sh = 0
-        for txin, prevout in zip(tx.vin, spent_outputs):
+        for txin, prevout in zip(tx.vin, spent_outputs, strict=True):
             if is_p2sh(prevout.script_pubkey) and is_push_only(txin.script_sig):
                 data = b""
                 for _opcode, pushed in iter_ops(txin.script_sig):
                     data = pushed if pushed is not None else b""
                 p2sh += get_sig_op_count(data, accurate=True)
         cost += p2sh * WITNESS_SCALE_FACTOR
-    for txin, prevout in zip(tx.vin, spent_outputs):
+    for txin, prevout in zip(tx.vin, spent_outputs, strict=True):
         cost += count_witness_sigops(
             txin.script_sig, prevout.script_pubkey, txin.witness, flags
         )
@@ -485,7 +485,7 @@ def _connect_block_impl(
     input_results: Optional[List[BatchResult]] = None
     if check_scripts:
         items: List[BatchItem] = []
-        for tx, spent_outputs in zip(block.vtx, per_tx_spent_outputs):
+        for tx, spent_outputs in zip(block.vtx, per_tx_spent_outputs, strict=True):
             if tx.is_coinbase():
                 continue
             raw = tx.serialize()

@@ -120,7 +120,7 @@ def jacobian_double(X, Y, Z):
 def _select(mask, a3, b3):
     """Per-lane select between two point triples; mask shape (...,)."""
     m = mask[None]
-    return tuple(jnp.where(m, x, y) for x, y in zip(a3, b3))
+    return tuple(jnp.where(m, x, y) for x, y in zip(a3, b3, strict=True))
 
 
 def _inf_like(X):

@@ -265,7 +265,7 @@ def _fold_high(x, bounds: Bounds) -> Tuple[jnp.ndarray, Bounds]:
 
 
 def _settled(bounds: Bounds) -> bool:
-    return len(bounds) == NLIMB and all(b <= w for b, w in zip(bounds, W2))
+    return len(bounds) == NLIMB and all(b <= w for b, w in zip(bounds, W2, strict=True))
 
 
 def _settle(x, bounds: Bounds):
@@ -315,7 +315,7 @@ def _sub_bias_limbs() -> np.ndarray:
 
 
 _SUB_BIAS = _sub_bias_limbs()
-_SUB_BOUNDS = [int(d) + w for d, w in zip(_SUB_BIAS, W2)]
+_SUB_BOUNDS = [int(d) + w for d, w in zip(_SUB_BIAS, W2, strict=True)]
 
 
 def fe_sub(a, b):

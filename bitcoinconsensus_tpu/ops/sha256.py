@@ -187,7 +187,7 @@ def tag_midstate(tag: str) -> np.ndarray:
         t2 = (S0 + maj) & 0xFFFFFFFF
         h, g, f, e, d, c, b, a = g, f, e, (d + t1) & 0xFFFFFFFF, c, b, a, (t1 + t2) & 0xFFFFFFFF
     return np.array(
-        [(x + y) & 0xFFFFFFFF for x, y in zip([a, b, c, d, e, f, g, h], s)],
+        [(x + y) & 0xFFFFFFFF for x, y in zip([a, b, c, d, e, f, g, h], s, strict=True)],
         dtype=np.uint32,
     )
 

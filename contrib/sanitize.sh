@@ -3,8 +3,10 @@
 # this discipline for its C (valgrind_ctime_test.c, fuzz harnesses); 3.8k
 # lines of C++ that parse adversarial transaction bytes get the same.
 #
-# Builds native/libnat_san.so (-fsanitize=address,undefined,
-# -fno-sanitize-recover=all: any diagnostic aborts the run) and replays
+# Builds native/libnat_san.so (-fsanitize=address,undefined plus an
+# explicit -fsanitize=shift,signed-integer-overflow for the consensus
+# arithmetic, -fno-sanitize-recover=all: any diagnostic aborts the run)
+# and replays
 # the native byte-identity suites, the batched driver tests, and the
 # drop-in ABI corpus (script_tests.json + byte mutations — the
 # adversarial codec paths) through the sanitized library.

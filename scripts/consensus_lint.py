@@ -1,0 +1,104 @@
+#!/usr/bin/env python
+"""Consensus lint: prove every registered kernel overflow-free and
+deterministic, and lint the host-side consensus path.
+
+    python scripts/consensus_lint.py            # everything (CI gate)
+    python scripts/consensus_lint.py --quick    # skip heavy kernels
+    python scripts/consensus_lint.py --kernel limbs.fe_mul
+    python scripts/consensus_lint.py --report out.json
+
+Exit status 0 iff every kernel proves clean AND the host lint is clean.
+The JSON report carries the derived per-limb output bounds of every
+kernel so reviewers can diff bounds across PRs (CI uploads it as a
+build artifact).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--quick", action="store_true",
+                    help="skip heavy kernels (GLV ladder, verify kernel)")
+    ap.add_argument("--kernel", action="append", default=None,
+                    help="analyze only the named kernel(s)")
+    ap.add_argument("--report", default=None,
+                    help="write the per-kernel bound report as JSON")
+    ap.add_argument("--list", action="store_true",
+                    help="list registered kernels and exit")
+    args = ap.parse_args()
+
+    from bitcoinconsensus_tpu.analysis import host_lint, registry
+
+    specs = registry.all_kernels(include_heavy=not args.quick)
+    if args.kernel:
+        wanted = set(args.kernel)
+        specs = [registry.get_kernel(n) for n in sorted(wanted)]
+    if args.list:
+        for s in registry.all_kernels():
+            print(f"{s.name:40s} {'heavy' if s.heavy else ''}")
+        return 0
+
+    print("== host lint (core/, models/) ==")
+    findings = host_lint.lint_consensus_host(REPO)
+    for f in findings:
+        print(f"  {f}")
+    host_ok = not findings
+    print(f"  {'clean' if host_ok else f'{len(findings)} finding(s)'}")
+
+    print("\n== kernel interval prover + determinism gate ==")
+    all_ok = host_ok
+    reports = []
+    for spec in specs:
+        t0 = time.time()
+        try:
+            rep = spec.analyze()
+        except Exception as e:  # trace failure is a gate failure
+            print(f"  {spec.name:40s} ERROR: {type(e).__name__}: {e}")
+            all_ok = False
+            reports.append({"name": spec.name, "ok": False,
+                            "error": f"{type(e).__name__}: {e}"})
+            continue
+        dt = time.time() - t0
+        status = "PROVEN" if rep.ok else "FAIL"
+        wraps = f" wraps={rep.wrap_eqns}" if rep.wrap_eqns else ""
+        print(f"  {spec.name:40s} {status}  eqns={rep.n_eqns}"
+              f" max|v|={rep.max_observed}{wraps}  ({dt:.1f}s)")
+        for v in rep.violations[:12]:
+            print(f"      {v.kind:10s} {v.where}")
+            print(f"                 {v.msg}")
+        if len(rep.violations) > 12:
+            print(f"      ... {len(rep.violations) - 12} more")
+        all_ok = all_ok and rep.ok
+        d = rep.to_dict()
+        d["seconds"] = round(dt, 2)
+        if spec.note:
+            d["note"] = spec.note
+        reports.append(d)
+
+    if args.report:
+        payload = {
+            "host_lint": [str(f) for f in findings],
+            "kernels": reports,
+        }
+        with open(args.report, "w") as fh:
+            json.dump(payload, fh, indent=2, sort_keys=True)
+        print(f"\nreport written to {args.report}")
+
+    print(f"\nconsensus lint: {'OK' if all_ok else 'FAILED'}")
+    return 0 if all_ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

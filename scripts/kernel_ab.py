@@ -53,7 +53,7 @@ def build_checks(n):
                 for a, s in items[0].spent_outputs
             ]
             txd = PrecomputedTxData(tx, outs)
-            for i, item in enumerate(items):
+            for i, _item in enumerate(items):
                 sig = tx.vin[i].witness[0]
                 sh = bip341_sighash(
                     tx, i, SIGHASH_DEFAULT, SigVersion.TAPROOT, txd, False, b""
@@ -62,7 +62,7 @@ def build_checks(n):
                 checks.append(SigCheck("schnorr", (pk, sig, sh)))
     # interleave + corrupt a few so both verdicts appear
     mixed = []
-    for a, b in zip(checks[: n // 2], checks[n // 2 :]):
+    for a, b in zip(checks[: n // 2], checks[n // 2 :], strict=False):
         mixed.extend((a, b))
     mixed = mixed[:n]
     for j in range(0, n, 97):

@@ -1,0 +1,198 @@
+"""Tests for the jaxpr-level consensus analyzer (`analysis/`).
+
+Three families:
+
+- pins: the analyzer's derived per-limb intervals for the settled field
+  ops must equal the hand-tracked constants documented in ops/limbs.py
+  (W2, and the `_pass`/`_fold_high` Bounds bookkeeping). A drift in
+  either direction is a finding: looser means the analyzer regressed,
+  tighter means the hand bounds are stale.
+- negatives: deliberately broken toy kernels (float creep, an
+  overflowing 14-bit radix, int64 intermediates, data-dependent while
+  loops, non-allowlisted primitives, understated hand bounds) must each
+  be flagged with the right violation kind.
+- sweeps (slow-marked): every registered kernel proves clean end to end,
+  exactly as the CI `analysis` job runs it.
+"""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax import lax
+
+from bitcoinconsensus_tpu.analysis import host_lint, registry
+from bitcoinconsensus_tpu.analysis import interval as IV
+from bitcoinconsensus_tpu.ops import limbs as L
+
+B = 2
+
+
+def _fe():
+    return jax.ShapeDtypeStruct((L.NLIMB, B), jnp.int32)
+
+
+def _w2_rows():
+    return [(0, int(w)) for w in L.W2]
+
+
+# ---------------------------------------------------------------------------
+# Pins: derived intervals == hand-tracked constants.
+
+
+def test_fe_add_output_rows_pin_w2():
+    rep = registry.get_kernel("limbs.fe_add").analyze()
+    assert rep.ok, rep.violations[:3]
+    assert rep.out_bounds[0] == _w2_rows()
+
+
+def test_fe_mul_output_rows_pin_w2():
+    rep = registry.get_kernel("limbs.fe_mul").analyze()
+    assert rep.ok, rep.violations[:3]
+    assert rep.out_bounds[0] == _w2_rows()
+
+
+def test_pass_derived_bounds_equal_hand_bounds():
+    # One carry pass from the fe_add pre-settle state (2*W2): the hand
+    # Bounds arithmetic in L._pass and the analyzer must agree row by row.
+    bounds = [2 * int(w) for w in L.W2]
+    _, hand = L._pass(np.zeros((L.NLIMB, 1), np.int32), bounds)
+    rep = IV.analyze(
+        lambda x: L._pass(x, bounds)[0], (_fe(),), "limbs._pass",
+        in_bounds={0: [(0, b) for b in bounds]},
+    )
+    assert rep.ok, rep.violations[:3]
+    assert rep.out_bounds[0] == [(0, int(b)) for b in hand]
+
+
+def test_fold_high_derived_bounds_equal_hand_bounds():
+    bounds = [int(w) for w in L.W2] + [37]
+    shape = jax.ShapeDtypeStruct((L.NLIMB + 1, B), jnp.int32)
+    _, hand = L._fold_high(np.zeros((L.NLIMB + 1, 1), np.int32), bounds)
+    rep = IV.analyze(
+        lambda x: L._fold_high(x, bounds)[0], (shape,), "limbs._fold_high",
+        in_bounds={0: [(0, b) for b in bounds]},
+    )
+    assert rep.ok, rep.violations[:3]
+    assert rep.out_bounds[0] == [(0, int(b)) for b in hand]
+
+
+# ---------------------------------------------------------------------------
+# Negatives: broken toy kernels must be flagged, with the right kind.
+
+
+def _kinds(rep):
+    return {v.kind for v in rep.violations}
+
+
+def test_float_creep_is_flagged():
+    def bad(x):
+        return (x.astype(jnp.float32) * 0.5).astype(jnp.int32)
+
+    rep = IV.analyze(bad, (_fe(),), "bad.float_creep", in_bounds={0: (0, 100)})
+    assert not rep.ok
+    assert "float" in _kinds(rep)
+
+
+def test_radix14_mul_overflow_is_flagged():
+    # fe_mul is only int32-safe under the 13-bit weak contract; feed it
+    # 14-bit limbs and the convolution must be caught exceeding int32.
+    rows = [(0, (1 << 14) - 1)] * L.NLIMB
+    rep = IV.analyze(L.fe_mul, (_fe(), _fe()), "bad.radix14",
+                     in_bounds={0: rows, 1: rows})
+    assert not rep.ok
+    assert "overflow" in _kinds(rep)
+
+
+def test_int64_intermediate_is_flagged():
+    def bad(x):
+        y = x.astype(jnp.int64)
+        return (y * y).astype(jnp.int32)
+
+    with jax.experimental.enable_x64():
+        closed = jax.make_jaxpr(bad)(jax.ShapeDtypeStruct((4,), jnp.int32))
+    rep = IV.analyze_closed(closed, "bad.int64", in_bounds={0: (0, 10)})
+    assert not rep.ok
+    assert "dtype64" in _kinds(rep)
+
+
+def test_data_dependent_while_is_flagged():
+    def bad(x):
+        return lax.while_loop(
+            lambda c: c[0] < c[1], lambda c: (c[0] + 1, c[1]),
+            (x[0, 0], x[1, 0]),
+        )[0]
+
+    rep = IV.analyze(bad, (_fe(),), "bad.while", in_bounds={0: (0, 100)})
+    assert not rep.ok
+    assert "loop" in _kinds(rep)
+
+
+def test_non_allowlisted_primitive_is_flagged():
+    def bad(x):
+        return lax.sort(x, dimension=0)
+
+    rep = IV.analyze(bad, (_fe(),), "bad.sort", in_bounds={0: (0, 100)})
+    assert not rep.ok
+    assert "allowlist" in _kinds(rep)
+
+
+def test_understating_hand_bound_fails_loudly():
+    rep = IV.analyze(
+        L.fe_add, (_fe(), _fe()), "bad.understate",
+        in_bounds={0: _w2_rows(), 1: _w2_rows()},
+        out_within=[[(0, 7)] * L.NLIMB],
+    )
+    assert not rep.ok
+    assert any("understates" in v.msg for v in rep.violations)
+
+
+# ---------------------------------------------------------------------------
+# Host-side AST lint.
+
+
+def test_host_lint_flags_violations(tmp_path):
+    p = tmp_path / "bad.py"
+    p.write_text(
+        "import random\n"
+        "import time\n"
+        "x = 0.5\n"
+        "y = float(3)\n"
+        "z = 1 / 2\n"
+        "t = time.time()\n"
+    )
+    rules = {f.rule for f in host_lint.lint_paths([str(p)])}
+    assert {"nondeterminism", "float-literal", "float-op",
+            "time-dependence"} <= rules
+
+
+def test_host_lint_clean_on_consensus_path():
+    repo = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(host_lint.__file__))))
+    assert host_lint.lint_consensus_host(repo) == []
+
+
+# ---------------------------------------------------------------------------
+# Full sweeps (slow: these re-prove whole kernels; the CI `analysis` job
+# is the canonical runner, these keep `pytest -m slow` equivalent).
+
+
+@pytest.mark.slow
+def test_every_quick_kernel_proves():
+    for spec in registry.all_kernels(include_heavy=False):
+        rep = spec.analyze()
+        assert rep.ok, (spec.name, rep.violations[:3])
+
+
+@pytest.mark.slow
+def test_glv_ladder_proves():
+    rep = registry.get_kernel("curve.double_scalar_mult_glv").analyze()
+    assert rep.ok, rep.violations[:3]
+
+
+@pytest.mark.slow
+def test_verify_kernel_proves():
+    rep = registry.get_kernel("jax_backend.verify_kernel").analyze()
+    assert rep.ok, rep.violations[:3]

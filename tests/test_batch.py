@@ -224,7 +224,7 @@ def test_batch_transport_error_order_matches_single():
     ]
     got = verify_batch(items)
     singles = [_single_verdict(it) for it in items]
-    for i, (res, (ok, err, _serr)) in enumerate(zip(got, singles)):
+    for i, (res, (ok, err, _serr)) in enumerate(zip(got, singles, strict=True)):
         assert (res.ok, res.error) == (ok, err), f"combo {i}"
     assert got[0].error == Error.ERR_TX_INDEX
     assert got[1].error == Error.ERR_TX_SIZE_MISMATCH
@@ -366,7 +366,7 @@ def test_adversarial_multisig_oracle_work_is_bounded():
         items, verifier=verifier, sig_cache=SigCache(),
         script_cache=ScriptExecutionCache(),
     )
-    for item, got in zip(items, res):
+    for item, got in zip(items, res, strict=True):
         want_ok, want_err, want_serr = _single_verdict(item)
         assert got.ok == want_ok
         if not want_ok:

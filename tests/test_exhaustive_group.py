@@ -121,7 +121,7 @@ def test_complete_add_every_branch_combination():
         x, y, got_inf = C.jacobian_to_affine(X, Y, Z)
         got_inf = np.asarray(got_inf)
         got = _affine_ints(x, y, got_inf)
-        for i, (want, tag) in enumerate(zip(expect, tags)):
+        for i, (want, tag) in enumerate(zip(expect, tags, strict=True)):
             assert (got[i] is None) == (want is None), (tag, "infinity", with_mask)
             if want is not None:
                 assert got[i] == want, (tag, "value", with_mask)
@@ -153,7 +153,7 @@ def test_flagged_add_defers_exactly_the_doubling_case():
     out_inf = np.asarray(out_inf)
     x, y, _ = C.jacobian_to_affine(X, Y, Z, inf=jnp.asarray(out_inf | needs))
     got = _affine_ints(x, y, out_inf | needs)
-    for i, (flag, want, tag) in enumerate(zip(expect_flag, expect_val, tags)):
+    for i, (flag, want, tag) in enumerate(zip(expect_flag, expect_val, tags, strict=True)):
         assert bool(needs[i]) == flag, (tag, "needs_dbl")
         if flag:
             continue
@@ -192,7 +192,7 @@ def test_complete_and_flagged_madd_all_pairings():
     out_inf = np.asarray(out_inf)
     x, y, _ = C.jacobian_to_affine(X, Y, Z, inf=jnp.asarray(out_inf))
     got = _affine_ints(x, y, out_inf)
-    for i, (want, tag) in enumerate(zip(expect, tags)):
+    for i, (want, tag) in enumerate(zip(expect, tags, strict=True)):
         assert (got[i] is None) == (want is None), (tag, "infinity")
         if want is not None:
             assert got[i] == want, (tag, "value")
@@ -204,7 +204,7 @@ def test_complete_and_flagged_madd_all_pairings():
     inf_f = np.asarray(inf_f)
     xf, yf, _ = C.jacobian_to_affine(Xf, Yf, Zf, inf=jnp.asarray(inf_f | needs))
     gotf = _affine_ints(xf, yf, inf_f | needs)
-    for i, (want, flag, tag) in enumerate(zip(expect, flags, tags)):
+    for i, (want, flag, tag) in enumerate(zip(expect, flags, tags, strict=True)):
         assert bool(needs[i]) == flag, (tag, "needs_dbl")
         if flag:
             continue

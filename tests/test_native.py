@@ -164,7 +164,7 @@ def test_prep_pack_bit_identical_to_python():
     py = v._pack_lanes(v._prep_lanes(checks))
     nat = NB.prep_pack(checks, 512)
     names = ["fields", "want_odd", "parity", "has_t2", "neg1", "neg2", "valid"]
-    for nm, a, b in zip(names, py, nat):
+    for nm, a, b in zip(names, py, nat, strict=True):
         a, b = np.asarray(a), np.asarray(b)
         assert a.shape == b.shape, nm
         assert (a == b).all(), (nm, np.argwhere(a != b)[:5])

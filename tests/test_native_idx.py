@@ -87,7 +87,7 @@ def test_idx_matches_wire_protocol():
     wire_digest = {}  # digest -> wire (kind, data)
     flat_wire = [r for recs in w_recs for r in recs] + w_spec
     wire_keys = native_bridge.digest_checks(b"salt!", flat_wire)
-    for k, r in zip(wire_keys, flat_wire):
+    for k, r in zip(wire_keys, flat_wire, strict=True):
         wire_digest[k] = r
     # every uniq entry is one of the wire-drained checks and vice versa
     uniq_keys = [dig[i].tobytes() for i in range(U)]
@@ -107,7 +107,7 @@ def test_idx_matches_wire_protocol():
     size = max(8, U)
     ref = native_bridge.prep_pack(checks, size)
     mine = sess.uniq_lanes(all_idx, size)
-    for a, b in zip(mine, ref):
+    for a, b in zip(mine, ref, strict=True):
         assert np.array_equal(np.asarray(a), np.asarray(b))
 
     # digests parity vs the sigcache key stream

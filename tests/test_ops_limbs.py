@@ -92,24 +92,24 @@ def test_add_sub_mul_vs_python():
     ia, ib = _to_ints(a), _to_ints(b)
 
     got = _to_ints(jax.jit(fe_add)(a, b))
-    for x, y, g in zip(ia, ib, got):
+    for x, y, g in zip(ia, ib, got, strict=True):
         assert g % P_INT == (x + y) % P_INT
 
     got = _to_ints(jax.jit(fe_sub)(a, b))
-    for x, y, g in zip(ia, ib, got):
+    for x, y, g in zip(ia, ib, got, strict=True):
         assert g % P_INT == (x - y) % P_INT
 
     got = _to_ints(jax.jit(fe_mul)(a, b))
-    for x, y, g in zip(ia, ib, got):
+    for x, y, g in zip(ia, ib, got, strict=True):
         assert g % P_INT == (x * y) % P_INT
 
     got = _to_ints(jax.jit(fe_sqr)(a))
-    for x, g in zip(ia, got):
+    for x, g in zip(ia, got, strict=True):
         assert g % P_INT == (x * x) % P_INT
 
     for k in (1, 2, 3, 8, 977, 2**17):
         got = _to_ints(jax.jit(lambda x, k=k: fe_mul_small(x, k))(a))
-        for x, g in zip(ia, got):
+        for x, g in zip(ia, got, strict=True):
             assert g % P_INT == (x * k) % P_INT
 
 
@@ -152,7 +152,7 @@ def test_inv_and_sqrt():
     vals = [1, 2, P_INT - 1, 0x7FFF] + [RNG.randrange(1, P_INT) for _ in range(8)]
     a = _batch(vals)
     inv = _to_ints(jax.jit(fe_inv)(a))
-    for x, g in zip(vals, inv):
+    for x, g in zip(vals, inv, strict=True):
         assert (x * g) % P_INT == 1
     # 0 -> 0 (Fermat inverse convention the group code relies on).
     z = np.asarray(jax.jit(fe_inv)(_batch([0, P_INT])))
@@ -163,7 +163,7 @@ def test_inv_and_sqrt():
     squares = [(v * v) % P_INT for v in vals]
     s = _batch(squares)
     cand = _to_ints(jax.jit(fe_sqrt)(s))
-    for sq, c in zip(squares, cand):
+    for sq, c in zip(squares, cand, strict=True):
         assert (c * c) % P_INT == sq
     nonres = []
     while len(nonres) < 4:
@@ -171,7 +171,7 @@ def test_inv_and_sqrt():
         if pow(v, (P_INT - 1) // 2, P_INT) == P_INT - 1:
             nonres.append(v)
     cand = _to_ints(jax.jit(fe_sqrt)(_batch(nonres)))
-    for v, c in zip(nonres, cand):
+    for v, c in zip(nonres, cand, strict=True):
         assert (c * c) % P_INT != v % P_INT
 
 
