@@ -12,6 +12,12 @@ Those modules must be bit-exact, replayable functions of their inputs:
 - no reading clocks (`time.time`, `datetime.now`, `time.monotonic` —
   anything time-dependent belongs to policy, not consensus).
 
+The clock rule also runs alone over `crypto/` (which legitimately uses
+float literals for jax config and fill-ratio math): all host-side timing
+flows through `bitcoinconsensus_tpu.obs` spans — the one sanctioned
+clock reader — so ad-hoc `time.perf_counter()` pairs cannot drift in
+beside the uniform telemetry.
+
 Pure-AST checks: no imports of the scanned modules, so a syntax-valid
 file is lintable even when its dependencies are not importable.
 """
@@ -21,7 +27,11 @@ from __future__ import annotations
 import ast
 import os
 from dataclasses import dataclass
-from typing import Iterator, List, Sequence
+from typing import FrozenSet, Iterator, List, Sequence
+
+# Rule groups, selectable per scanned tree.
+ALL_RULES = frozenset({"float", "nondeterminism", "time"})
+TIMING_RULES = frozenset({"time"})
 
 BANNED_IMPORTS = {"random", "secrets"}
 # module.attr calls whose mere presence is a violation
@@ -51,8 +61,9 @@ def _is_float_literal(node: ast.Constant) -> bool:
 
 
 class _Visitor(ast.NodeVisitor):
-    def __init__(self, path: str):
+    def __init__(self, path: str, rules: FrozenSet[str] = ALL_RULES):
         self.path = path
+        self.rules = rules
         self.findings: List[LintFinding] = []
 
     def _flag(self, node, rule, msg):
@@ -60,42 +71,53 @@ class _Visitor(ast.NodeVisitor):
             LintFinding(self.path, getattr(node, "lineno", 0), rule, msg))
 
     def visit_Constant(self, node: ast.Constant):
-        if _is_float_literal(node):
+        if "float" in self.rules and _is_float_literal(node):
             self._flag(node, "float-literal",
                        f"float literal {node.value!r} in consensus host "
                        "code (integer semantics only)")
         self.generic_visit(node)
 
     def visit_Import(self, node: ast.Import):
-        for alias in node.names:
-            root = alias.name.split(".")[0]
-            if root in BANNED_IMPORTS:
-                self._flag(node, "nondeterminism",
-                           f"import of `{alias.name}` (entropy source)")
+        if "nondeterminism" in self.rules:
+            for alias in node.names:
+                root = alias.name.split(".")[0]
+                if root in BANNED_IMPORTS:
+                    self._flag(node, "nondeterminism",
+                               f"import of `{alias.name}` (entropy source)")
         self.generic_visit(node)
 
     def visit_ImportFrom(self, node: ast.ImportFrom):
         root = (node.module or "").split(".")[0]
-        if root in BANNED_IMPORTS:
+        if "nondeterminism" in self.rules and root in BANNED_IMPORTS:
             self._flag(node, "nondeterminism",
                        f"import from `{node.module}` (entropy source)")
         self.generic_visit(node)
 
     def visit_Call(self, node: ast.Call):
         fn = node.func
-        if isinstance(fn, ast.Attribute) and isinstance(fn.value, ast.Name):
+        if (
+            "time" in self.rules
+            and isinstance(fn, ast.Attribute)
+            and isinstance(fn.value, ast.Name)
+        ):
             key = (fn.value.id, fn.attr)
             if key in BANNED_CALLS:
                 self._flag(node, "time-dependence",
-                           f"call to {key[0]}.{key[1]}() — consensus "
-                           "verdicts must not read clocks")
-        if isinstance(fn, ast.Name) and fn.id in FLOAT_CAST:
+                           f"call to {key[0]}.{key[1]}() — time flows "
+                           "through obs spans only (consensus verdicts "
+                           "must not read clocks, and ad-hoc timing "
+                           "bypasses the telemetry registry)")
+        if (
+            "float" in self.rules
+            and isinstance(fn, ast.Name)
+            and fn.id in FLOAT_CAST
+        ):
             self._flag(node, "float-op",
                        "float() cast in consensus host code")
         self.generic_visit(node)
 
     def visit_BinOp(self, node: ast.BinOp):
-        if isinstance(node.op, ast.Div):
+        if "float" in self.rules and isinstance(node.op, ast.Div):
             self._flag(node, "float-op",
                        "true division `/` yields float; use `//` for "
                        "integer consensus arithmetic")
@@ -109,7 +131,9 @@ def _iter_py(root: str) -> Iterator[str]:
                 yield os.path.join(dirpath, f)
 
 
-def lint_paths(paths: Sequence[str]) -> List[LintFinding]:
+def lint_paths(
+    paths: Sequence[str], rules: FrozenSet[str] = ALL_RULES
+) -> List[LintFinding]:
     findings: List[LintFinding] = []
     for root in paths:
         files = _iter_py(root) if os.path.isdir(root) else [root]
@@ -122,13 +146,18 @@ def lint_paths(paths: Sequence[str]) -> List[LintFinding]:
                 findings.append(LintFinding(path, e.lineno or 0,
                                             "syntax", str(e)))
                 continue
-            v = _Visitor(path)
+            v = _Visitor(path, rules)
             v.visit(tree)
             findings.extend(v.findings)
     return findings
 
 
 def lint_consensus_host(repo_root: str) -> List[LintFinding]:
+    """Full rules over core/ + models/; clock rule alone over crypto/
+    (its device-dispatch driver may use floats but must route timing
+    through obs spans, never raw perf_counter pairs)."""
     pkg = os.path.join(repo_root, "bitcoinconsensus_tpu")
-    return lint_paths([os.path.join(pkg, "core"),
-                       os.path.join(pkg, "models")])
+    findings = lint_paths([os.path.join(pkg, "core"),
+                           os.path.join(pkg, "models")])
+    findings += lint_paths([os.path.join(pkg, "crypto")], rules=TIMING_RULES)
+    return findings

@@ -31,6 +31,8 @@ from .core.script_error import ScriptError
 from .core.serialize import SerializationError
 from .core.sighash import PrecomputedTxData
 from .core.tx import Tx, TxOut
+from .obs import counter as _obs_counter
+from .obs import span as _span
 
 __all__ = [
     "Error",
@@ -45,6 +47,29 @@ __all__ = [
 ]
 
 API_VERSION = 1  # bitcoinconsensus.h:36 BITCOINCONSENSUS_API_VER
+
+# Telemetry (README "Observability"): per-entry call counters and
+# reject-reason counters keyed by the transport Error code and, for script
+# failures, the exact ScriptError — the observable the reference swallows.
+_VERIFY_CALLS = _obs_counter(
+    "consensus_verify_calls_total", "verify* entry-point calls", ("entry",)
+)
+_VERIFY_REJECTS = _obs_counter(
+    "consensus_verify_reject_total",
+    "verify rejections by transport Error code (api + batch paths)",
+    ("code",),
+)
+_SCRIPT_REJECTS = _obs_counter(
+    "consensus_script_reject_total",
+    "script-level rejections by ScriptError code (api + batch paths)",
+    ("script_error",),
+)
+
+
+def _record_reject(exc: "ConsensusError") -> None:
+    _VERIFY_REJECTS.inc(code=exc.code.name)
+    if exc.script_error is not None and exc.script_error != ScriptError.OK:
+        _SCRIPT_REJECTS.inc(script_error=exc.script_error.name)
 
 
 class Error(enum.IntEnum):
@@ -157,6 +182,36 @@ def _verify_input(
         raise ConsensusError(Error.ERR_SCRIPT, script_err)
 
 
+def _verify_entry(
+    entry: str,
+    spent_output_script: bytes,
+    amount: int,
+    spending_transaction: bytes,
+    input_index: int,
+    flags: int,
+    allowed_flags: int,
+    spent_outputs: Optional[Sequence[TxOut]] = None,
+) -> None:
+    """Instrumented shared body of the public entry points: one span per
+    call, reject-reason counters on failure (the counters are cumulative
+    process totals; `scripts/consensus_stats.py` snapshots them)."""
+    _VERIFY_CALLS.inc(entry=entry)
+    with _span(f"api.{entry}"):
+        try:
+            _verify_input(
+                spent_output_script,
+                amount,
+                spending_transaction,
+                input_index,
+                flags,
+                allowed_flags=allowed_flags,
+                spent_outputs=spent_outputs,
+            )
+        except ConsensusError as e:
+            _record_reject(e)
+            raise
+
+
 def verify(
     spent_output: bytes,
     amount: int,
@@ -167,8 +222,14 @@ def verify(
 
     Raises ConsensusError on failure; returns None on success.
     """
-    verify_with_flags(
-        spent_output, amount, spending_transaction, input_index, VERIFY_ALL_LIBCONSENSUS
+    _verify_entry(
+        "verify",
+        spent_output,
+        amount,
+        spending_transaction,
+        input_index,
+        VERIFY_ALL_LIBCONSENSUS,
+        allowed_flags=LIBCONSENSUS_FLAGS,
     )
 
 
@@ -181,7 +242,8 @@ def verify_with_flags(
 ) -> None:
     """verify_with_flags (src/lib.rs:113-139): same flag restriction as the
     reference C ABI (only libconsensus bits accepted)."""
-    _verify_input(
+    _verify_entry(
+        "verify_with_flags",
         spent_output_script,
         amount,
         spending_transaction,
@@ -205,8 +267,12 @@ def verify_with_spent_outputs(
     """
     outs = [TxOut(amt, spk) for amt, spk in spent_outputs]
     if input_index < 0 or input_index >= len(outs):
-        raise ConsensusError(Error.ERR_TX_INDEX)
-    _verify_input(
+        _VERIFY_CALLS.inc(entry="verify_with_spent_outputs")
+        exc = ConsensusError(Error.ERR_TX_INDEX)
+        _record_reject(exc)
+        raise exc
+    _verify_entry(
+        "verify_with_spent_outputs",
         outs[input_index].script_pubkey,
         outs[input_index].value,
         spending_transaction,

@@ -46,6 +46,8 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from ..obs import counter as _obs_counter
+from ..obs import gauge as _obs_gauge
 from ..utils.hashes import tagged_hash
 from ..utils.gcpause import gc_paused
 from ..utils.profiling import Phases
@@ -87,6 +89,36 @@ try:  # pragma: no cover - depends on jax version/platform
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
 except Exception:
     pass
+
+# Device-dispatch telemetry (README "Observability"). All host-side: these
+# run in the driver around `jit` calls, never inside a traced program, so
+# the analysis determinism gate sees identical kernel jaxprs.
+_CHECKS_TOTAL = _obs_counter(
+    "consensus_checks_total", "deferred curve checks by kind", ("kind",)
+)
+_DISPATCH_TOTAL = _obs_counter(
+    "consensus_dispatch_total", "device dispatches by backend", ("backend",)
+)
+_DISPATCH_LANES = _obs_counter(
+    "consensus_dispatch_lanes_total", "real (unpadded) lanes dispatched"
+)
+_DISPATCH_PADDED = _obs_counter(
+    "consensus_dispatch_padded_lanes_total",
+    "padded lanes dispatched (pad ladder fill)",
+)
+_DISPATCH_FILL = _obs_gauge(
+    "consensus_dispatch_fill_ratio",
+    "real/padded lane ratio of the most recent dispatch",
+)
+_NEW_SHAPES = _obs_counter(
+    "consensus_dispatch_new_shapes_total",
+    "distinct padded dispatch shapes this process (each is one jit "
+    "compile or persistent-cache load)",
+)
+_HOST_FIXUPS = _obs_counter(
+    "consensus_host_fixup_total",
+    "exceptional device lanes resolved exactly on host",
+)
 
 
 class SigCheck:
@@ -389,6 +421,9 @@ class TpuSecpVerifier:
         # Set when a deferred exceptional-case lane (pallas fast-add flag)
         # resolved FALSE on the host — consumed by the sharded verdict.
         self._fixup_failed = False
+        # Padded shapes this instance has dispatched: first sight of a
+        # shape means one jit compile (or persistent-cache load).
+        self._seen_shapes: set = set()
         self.phases = Phases()  # host_prep / pack / dispatch / sync
 
     def _pad(self, n: int) -> int:
@@ -457,6 +492,11 @@ class TpuSecpVerifier:
         """
         if not checks:
             return np.zeros(0, dtype=bool)
+        kinds: dict = {}
+        for c in checks:
+            kinds[c.kind] = kinds.get(c.kind, 0) + 1
+        for k, cnt in kinds.items():
+            _CHECKS_TOTAL.inc(cnt, kind=k)
         with gc_paused():
             return self._verify_checks_impl(checks)
 
@@ -490,6 +530,7 @@ class TpuSecpVerifier:
                         # collisions): the fast device adds deferred them;
                         # resolve exactly on host (never hit by honest
                         # traffic — tests/test_pallas_kernel.py crafts one).
+                        _HOST_FIXUPS.inc(int(needs_np.sum()))
                         for i in np.nonzero(needs_np)[0]:
                             r = self._host_check(checks[start + int(i)])
                             out[start + int(i)] = r
@@ -572,19 +613,34 @@ class TpuSecpVerifier:
         valid[:n] = [lane.valid for lane in lanes]
         return fields, want_odd, parity, has_t2, neg1, neg2, valid
 
+    def _note_dispatch(self, padded: int, n: int, backend: str) -> None:
+        """Dispatch accounting — called around, never inside, the jit'd
+        program, so kernel jaxprs are identical with telemetry on."""
+        _DISPATCH_TOTAL.inc(backend=backend)
+        _DISPATCH_LANES.inc(n)
+        _DISPATCH_PADDED.inc(padded)
+        if padded:
+            _DISPATCH_FILL.set(n / padded)
+        if padded not in self._seen_shapes:
+            self._seen_shapes.add(padded)
+            _NEW_SHAPES.inc()
+
     def _run_kernel(self, args: Tuple, n: int):
         """Dispatch seam: subclasses (mesh sharding) override to add a live
         mask / collective verdict. `n` is the count of real (unpadded)
         lanes. Returns the (async) device result — a plain ok array (XLA
         complete-add kernel) or an (ok, needs_host) tuple (pallas fast-add
         kernel; flagged lanes are resolved host-side in verify_checks)."""
+        padded = int(args[0].shape[0])
         if self._use_pallas:
             # Deferred import keeps CPU-only paths light; LANE_TILE is the
             # kernel's own tile so the guard cannot drift from its assert.
             from ..ops.pallas_kernel import LANE_TILE, verify_tiles
 
-            if args[0].shape[0] % LANE_TILE == 0:
+            if padded % LANE_TILE == 0:
+                self._note_dispatch(padded, n, "pallas")
                 return verify_tiles(*args)
+        self._note_dispatch(padded, n, "xla")
         return self._kernel(*args)
 
     # Convenience single-check wrappers (used by tests/differential fuzzing).

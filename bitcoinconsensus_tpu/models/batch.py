@@ -49,6 +49,9 @@ from ..core.sighash import PrecomputedTxData
 from ..core.tx import Tx, TxOut
 from ..crypto.jax_backend import SigCheck, TpuSecpVerifier, default_verifier
 from .. import native_bridge
+from ..obs import counter as _obs_counter
+from ..obs import histogram as _obs_histogram
+from ..obs import span as _span
 from ..utils.gcpause import gc_paused
 from .sigcache import (
     ScriptExecutionCache,
@@ -58,6 +61,81 @@ from .sigcache import (
 )
 
 __all__ = ["BatchItem", "BatchResult", "verify_batch"]
+
+# Batch-driver telemetry (README "Observability"). All updates are host
+# side and integer-valued — this module is under the host AST lint, which
+# bans float literals and clock reads; timing flows through obs spans (the
+# one sanctioned clock reader).
+_BATCH_SIZE = _obs_histogram(
+    "consensus_batch_size",
+    "items per verify_batch call",
+    buckets=(1, 8, 64, 512, 4096, 32768),
+)
+_BATCH_ITEMS = _obs_counter(
+    "consensus_batch_items_total", "inputs submitted to verify_batch"
+)
+_BATCH_RESULTS = _obs_counter(
+    "consensus_batch_results_total",
+    "verify_batch results by outcome",
+    ("outcome",),
+)
+_FIXPOINT_ROUNDS = _obs_histogram(
+    "consensus_fixpoint_rounds",
+    "oracle re-interpretation rounds needed per batch fixpoint",
+    buckets=(1, 2, 3, 4, 6, 8, 12, 24),
+)
+_EXACT_FALLBACK = _obs_counter(
+    "consensus_exact_fallback_total",
+    "inputs resolved by the exact host checker at the round cap",
+)
+_UNIQ_CHECKS = _obs_counter(
+    "consensus_uniq_checks_total",
+    "deduplicated curve checks discovered (uniq-list growth, index mode)",
+)
+# Shared with crypto/jax_backend.py: exceptional device lanes resolved
+# exactly on host, whichever driver flags them.
+_HOST_FIXUPS = _obs_counter(
+    "consensus_host_fixup_total",
+    "exceptional device lanes resolved exactly on host",
+)
+# Reject-reason counters are shared with the per-input API entry points
+# (same registry names -> one process-wide view across both paths).
+_VERIFY_REJECTS = _obs_counter(
+    "consensus_verify_reject_total",
+    "verify rejections by transport Error code (api + batch paths)",
+    ("code",),
+)
+_SCRIPT_REJECTS = _obs_counter(
+    "consensus_script_reject_total",
+    "script-level rejections by ScriptError code (api + batch paths)",
+    ("script_error",),
+)
+
+
+def _record_batch_results(out: List["BatchResult"]) -> None:
+    """Aggregate result counters locally, then publish once per batch —
+    bounded lock traffic no matter the batch size."""
+    ok_n = 0
+    rejects: Dict[Tuple[str, Optional[str]], int] = {}
+    for r in out:
+        if r.ok:
+            ok_n += 1
+        else:
+            serr = (
+                r.script_error.name
+                if r.script_error is not None
+                and r.script_error != ScriptError.OK
+                else None
+            )
+            key = (r.error.name, serr)
+            rejects[key] = rejects.get(key, 0) + 1
+    if ok_n:
+        _BATCH_RESULTS.inc(ok_n, outcome="ok")
+    for (code, serr), n in rejects.items():
+        _BATCH_RESULTS.inc(n, outcome="reject")
+        _VERIFY_REJECTS.inc(n, code=code)
+        if serr is not None:
+            _SCRIPT_REJECTS.inc(n, script_error=serr)
 
 
 @dataclass
@@ -337,6 +415,7 @@ def _resolve_uniq(nsess, verifier, sig_cache, state: _UniqState) -> None:
     lo = len(state.val)
     if U == lo:
         return
+    _UNIQ_CHECKS.inc(U - lo)
     grow = np.arange(lo, U, dtype=np.int32)
     with verifier.phases("host_prep"):
         digs = nsess.uniq_digests(sig_cache._salt, grow)
@@ -365,7 +444,9 @@ def _resolve_uniq(nsess, verifier, sig_cache, state: _UniqState) -> None:
             okv, needs = verifier.sync_lanes(pend, len(sub))
             okv = np.array(okv, dtype=bool, copy=True)
             if needs is not None and needs.any():
-                for t in np.nonzero(needs)[0]:
+                fix = np.nonzero(needs)[0]
+                _HOST_FIXUPS.inc(len(fix))
+                for t in fix:
                     r = nsess.uniq_host_verify(int(sub[t]))
                     okv[t] = r
                     if not r:
@@ -397,11 +478,15 @@ def run_idx_fixpoint(
     final: Dict[int, Tuple[bool, int]] = {}
     state = _UniqState()
     pending = list(live)
+    rounds = 0
     for _round in range(max_rounds):
         if not pending:
             break
-        ok, err, unk, rec_idx, bounds = run_idx(pending)
-        _resolve_uniq(nsess, verifier, sig_cache, state)
+        rounds += 1
+        with _span("batch.interpret", n=len(pending)):
+            ok, err, unk, rec_idx, bounds = run_idx(pending)
+        with _span("batch.resolve"):
+            _resolve_uniq(nsess, verifier, sig_cache, state)
         # exact verdict (unk == 0), or optimistic with every guess
         # confirmed true — equivalent to an exact pass
         accept = _accept_mask(state, rec_idx, bounds, unk)
@@ -412,7 +497,10 @@ def run_idx_fixpoint(
             else:
                 still.append(idx)
         pending = still
-    for idx in pending:  # round cap hit: exact host fallback
+    _FIXPOINT_ROUNDS.observe(rounds)
+    if pending:  # round cap hit: exact host fallback
+        _EXACT_FALLBACK.inc(len(pending))
+    for idx in pending:
         final[idx] = exact_fallback(idx)
     return final
 
@@ -498,8 +586,12 @@ def verify_batch(
     driver's allocation churn otherwise triggers repeated full GC passes
     over the JAX runtime's heap — measured 12x on cached replays.
     """
-    with gc_paused():
-        return _verify_batch_impl(items, verifier, sig_cache, script_cache)
+    _BATCH_SIZE.observe(len(items))
+    _BATCH_ITEMS.inc(len(items))
+    with gc_paused(), _span("batch.verify_batch", n=len(items)):
+        out = _verify_batch_impl(items, verifier, sig_cache, script_cache)
+    _record_batch_results(out)
+    return out
 
 
 def _verify_batch_impl(
@@ -521,37 +613,40 @@ def _verify_batch_impl(
     txdata_cache: Dict[Tuple, PrecomputedTxData] = {}
     spent_memo: Dict[int, Tuple] = {}
     ntx_cache: Optional[Dict] = {} if use_native else None
-    preps = [
-        _prepare(item, tx_cache, txdata_cache, spent_memo, ntx_cache)
-        for item in items
-    ]
+    with _span("batch.prepare", n=len(items)):
+        preps = [
+            _prepare(item, tx_cache, txdata_cache, spent_memo, ntx_cache)
+            for item in items
+        ]
 
     # Script-execution cache probe: a hit certifies this exact
     # (wtxid, input, flags, prevouts) succeeded before — skip the
     # interpreter and the device outright (validation.cpp:1529-1536).
     script_keys: List[Optional[bytes]] = [None] * len(items)
-    probe_idx: List[int] = []
-    probe_parts: List[Tuple[bytes, ...]] = []
-    for idx, (item, prep) in enumerate(zip(items, preps, strict=True)):
-        if prep.result is not None or prep.wtxid is None:
-            continue
-        if item.spent_outputs is not None:
-            digest = _spent_memo_entry(item, spent_memo)[1]
-        else:
-            digest = ScriptExecutionCache.spent_digest(
-                [(item.amount, item.spent_output_script or b"")]
+    with _span("batch.probe"):
+        probe_idx: List[int] = []
+        probe_parts: List[Tuple[bytes, ...]] = []
+        for idx, (item, prep) in enumerate(zip(items, preps, strict=True)):
+            if prep.result is not None or prep.wtxid is None:
+                continue
+            if item.spent_outputs is not None:
+                digest = _spent_memo_entry(item, spent_memo)[1]
+            else:
+                digest = ScriptExecutionCache.spent_digest(
+                    [(item.amount, item.spent_output_script or b"")]
+                )
+            probe_idx.append(idx)
+            probe_parts.append(
+                ScriptExecutionCache._parts(
+                    prep.wtxid, item.input_index, item.flags, digest
+                )
             )
-        probe_idx.append(idx)
-        probe_parts.append(
-            ScriptExecutionCache._parts(
-                prep.wtxid, item.input_index, item.flags, digest
-            )
-        )
-    for idx, key in zip(probe_idx, script_cache.keys_for_parts(probe_parts),
-                        strict=True):
-        script_keys[idx] = key
-        if script_cache.contains_key(key):
-            preps[idx].result = BatchResult.success()
+        for idx, key in zip(probe_idx,
+                            script_cache.keys_for_parts(probe_parts),
+                            strict=True):
+            script_keys[idx] = key
+            if script_cache.contains_key(key):
+                preps[idx].result = BatchResult.success()
 
     # Fast path: with the native core on, every prep either failed
     # transport checks (result set) or holds a native tx handle — the
@@ -586,31 +681,34 @@ def _verify_batch_impl(
         return ok, err, checker.unknown, checker.recorded
 
     known: Dict[Tuple, bool] = {}
-    native_idx = [
-        idx
-        for idx, prep in enumerate(preps)
-        if prep.result is None and prep.ntx is not None
-    ]
-    if native_idx:
-        # ONE C call interprets every native-parsed input (the per-call
-        # bridge overhead dominates a block-sized batch otherwise).
-        ok_a, err_a, _unk_a, recs = nsess.verify_inputs(
-            [preps[i].ntx for i in native_idx],
-            [items[i].input_index for i in native_idx],
-            [preps[i].amount for i in native_idx],
-            [preps[i].script_pubkey for i in native_idx],
-            [items[i].flags for i in native_idx],
-            mode=native_bridge.NativeSession.MODE_DEFER,
-        )
-        for j, idx in enumerate(native_idx):
-            preps[idx].optimistic = (bool(ok_a[j]), ScriptError(int(err_a[j])))
-            preps[idx].checks = [SigCheck(k, d) for k, d in recs[j]]
-    for item, prep in zip(items, preps, strict=True):
-        if prep.result is not None or prep.ntx is not None:
-            continue
-        ok, err, _unk, checks = interpret_deferring(item, prep)
-        prep.optimistic = (ok, err)
-        prep.checks = checks
+    with _span("batch.interpret"):
+        native_idx = [
+            idx
+            for idx, prep in enumerate(preps)
+            if prep.result is None and prep.ntx is not None
+        ]
+        if native_idx:
+            # ONE C call interprets every native-parsed input (the per-call
+            # bridge overhead dominates a block-sized batch otherwise).
+            ok_a, err_a, _unk_a, recs = nsess.verify_inputs(
+                [preps[i].ntx for i in native_idx],
+                [items[i].input_index for i in native_idx],
+                [preps[i].amount for i in native_idx],
+                [preps[i].script_pubkey for i in native_idx],
+                [items[i].flags for i in native_idx],
+                mode=native_bridge.NativeSession.MODE_DEFER,
+            )
+            for j, idx in enumerate(native_idx):
+                preps[idx].optimistic = (
+                    bool(ok_a[j]), ScriptError(int(err_a[j]))
+                )
+                preps[idx].checks = [SigCheck(k, d) for k, d in recs[j]]
+        for item, prep in zip(items, preps, strict=True):
+            if prep.result is not None or prep.ntx is not None:
+                continue
+            ok, err, _unk, checks = interpret_deferring(item, prep)
+            prep.optimistic = (ok, err)
+            prep.checks = checks
 
     # Speculative CHECKMULTISIG pairings recorded by the native engine ride
     # the same first dispatch (they are resolve-only: never part of any
@@ -643,28 +741,32 @@ def _verify_batch_impl(
         """Fill `known` for every check: sig-cache probe (keys digested in
         one native call), then ONE deduplicated device dispatch; successes
         feed the cache."""
-        todo: List[SigCheck] = []
-        for chk in checks:
-            key = (chk.kind, chk.data)
-            if key in known:
-                continue
-            known[key] = False  # placeholder until probed/dispatched
-            todo.append(chk)
-        if todo:
-            cache_keys = sig_cache.keys_for_checks(todo)
-            fresh: List[Tuple[SigCheck, bytes]] = []
-            for chk, ck in zip(todo, cache_keys, strict=True):
-                if sig_cache.contains_key(ck):
-                    known[(chk.kind, chk.data)] = True
-                else:
-                    fresh.append((chk, ck))
-            if fresh:
-                run_res = verifier.verify_checks([c for c, _ in fresh])
-                for (chk, ck), r in zip(fresh, run_res, strict=True):
-                    known[(chk.kind, chk.data)] = bool(r)
-                    if r:  # success-only insertion, like the reference
-                        sig_cache.add_key(ck)
-        publish_known()
+        with _span("batch.resolve"):
+            todo: List[SigCheck] = []
+            for chk in checks:
+                key = (chk.kind, chk.data)
+                if key in known:
+                    continue
+                known[key] = False  # placeholder until probed/dispatched
+                todo.append(chk)
+            if todo:
+                # Same observable as the index-mode uniq-list growth: how
+                # many deduplicated checks this batch actually discovered.
+                _UNIQ_CHECKS.inc(len(todo))
+                cache_keys = sig_cache.keys_for_checks(todo)
+                fresh: List[Tuple[SigCheck, bytes]] = []
+                for chk, ck in zip(todo, cache_keys, strict=True):
+                    if sig_cache.contains_key(ck):
+                        known[(chk.kind, chk.data)] = True
+                    else:
+                        fresh.append((chk, ck))
+                if fresh:
+                    run_res = verifier.verify_checks([c for c, _ in fresh])
+                    for (chk, ck), r in zip(fresh, run_res, strict=True):
+                        known[(chk.kind, chk.data)] = bool(r)
+                        if r:  # success-only insertion, like the reference
+                            sig_cache.add_key(ck)
+            publish_known()
 
     resolve([chk for prep in preps for chk in prep.checks] + drain_spec())
 
@@ -686,9 +788,11 @@ def _verify_batch_impl(
             pending.append(idx)
 
     max_rounds = 24  # > MAX_PUBKEYS_PER_MULTISIG cursor retries
+    rounds = 1  # the optimistic pass above is round one
     for _round in range(max_rounds):
         if not pending:
             break
+        rounds += 1
         new_checks: List[SigCheck] = []
         still: List[int] = []
         nat_pending = [i for i in pending if preps[i].ntx is not None]
@@ -723,7 +827,10 @@ def _verify_batch_impl(
         resolve(new_checks + drain_spec())
         pending = still
 
-    for idx in pending:  # round cap hit: exact host fallback
+    _FIXPOINT_ROUNDS.observe(rounds)
+    if pending:  # round cap hit: exact host fallback
+        _EXACT_FALLBACK.inc(len(pending))
+    for idx in pending:
         item, prep = items[idx], preps[idx]
         if prep.ntx is not None:
             ok, err_code, _ = nsess.verify_input(

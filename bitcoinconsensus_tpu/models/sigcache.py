@@ -37,6 +37,9 @@ import threading
 from collections import OrderedDict
 from typing import Iterable, Optional, Tuple
 
+from ..obs import counter as _obs_counter
+from ..obs import gauge as _obs_gauge
+
 __all__ = [
     "SigCache",
     "ScriptExecutionCache",
@@ -44,11 +47,36 @@ __all__ = [
     "default_script_cache",
 ]
 
+# Cache telemetry, labeled by cache role ("sig" / "script"; tests pass
+# their own labels to isolate). Invariants asserted by tests/test_sigcache:
+# hits + misses == lookups; insertions - evictions - erases == entries.
+_C_LOOKUPS = _obs_counter(
+    "consensus_cache_lookups_total", "cache probes", ("cache",)
+)
+_C_HITS = _obs_counter("consensus_cache_hits_total", "cache hits", ("cache",))
+_C_MISSES = _obs_counter(
+    "consensus_cache_misses_total", "cache misses", ("cache",)
+)
+_C_INSERTS = _obs_counter(
+    "consensus_cache_insertions_total", "cache insertions", ("cache",)
+)
+_C_EVICTS = _obs_counter(
+    "consensus_cache_evictions_total", "LRU evictions past max_entries",
+    ("cache",),
+)
+_C_ERASES = _obs_counter(
+    "consensus_cache_erases_total",
+    "erase-on-hit removals (Core's mempool->block pattern)", ("cache",),
+)
+_C_ENTRIES = _obs_gauge(
+    "consensus_cache_entries", "current cache entry count", ("cache",)
+)
+
 
 class _SaltedLRU:
     """Bounded success-set with a per-process salted key digest."""
 
-    def __init__(self, max_entries: int):
+    def __init__(self, max_entries: int, cache_label: str = "cache"):
         assert max_entries > 0
         self._salt = os.urandom(32)
         self._max = max_entries
@@ -56,6 +84,19 @@ class _SaltedLRU:
         self._lock = threading.Lock()
         self.hits = 0
         self.misses = 0
+        self.evictions = 0
+        self.erases = 0
+        self.insertions = 0
+        # Bound metric children: one dict lookup + label-key build at
+        # construction, plain locked adds on the probe/insert hot paths.
+        lbl = {"cache": cache_label}
+        self._m_lookups = _C_LOOKUPS.labels(**lbl)
+        self._m_hits = _C_HITS.labels(**lbl)
+        self._m_misses = _C_MISSES.labels(**lbl)
+        self._m_inserts = _C_INSERTS.labels(**lbl)
+        self._m_evicts = _C_EVICTS.labels(**lbl)
+        self._m_erases = _C_ERASES.labels(**lbl)
+        self._m_entries = _C_ENTRIES.labels(**lbl)
 
     def _key(self, parts: Iterable[bytes]) -> bytes:
         h = hashlib.sha256(self._salt)
@@ -67,22 +108,44 @@ class _SaltedLRU:
     def contains_key(self, k: bytes, erase: bool = False) -> bool:
         """Probe by a precomputed digest (see SigCache.keys_for_checks)."""
         with self._lock:
-            if k in self._set:
+            hit = k in self._set
+            if hit:
                 self.hits += 1
                 if erase:
                     del self._set[k]
+                    self.erases += 1
                 else:
                     self._set.move_to_end(k)
-                return True
-            self.misses += 1
-            return False
+            else:
+                self.misses += 1
+            size = len(self._set)
+        # Registry updates outside the cache lock: no nested-lock ordering
+        # to reason about, and a slow metrics path can never stall probes.
+        self._m_lookups.inc()
+        if hit:
+            self._m_hits.inc()
+            if erase:
+                self._m_erases.inc()
+                self._m_entries.set(size)
+        else:
+            self._m_misses.inc()
+        return hit
 
     def add_key(self, k: bytes) -> None:
         with self._lock:
             self._set[k] = None
             self._set.move_to_end(k)
+            evicted = 0
             while len(self._set) > self._max:
                 self._set.popitem(last=False)
+                evicted += 1
+            self.evictions += evicted
+            self.insertions += 1
+            size = len(self._set)
+        self._m_inserts.inc()
+        if evicted:
+            self._m_evicts.inc(evicted)
+        self._m_entries.set(size)
 
     def contains(self, parts: Iterable[bytes], erase: bool = False) -> bool:
         return self.contains_key(self._key(parts), erase=erase)
@@ -111,8 +174,8 @@ class SigCache(_SaltedLRU):
     `contains` on a hit refreshes recency (Core's mempool->block pattern
     uses erase-on-hit from the block path; pass erase=True to match)."""
 
-    def __init__(self, max_entries: int = 1 << 16):
-        super().__init__(max_entries)
+    def __init__(self, max_entries: int = 1 << 16, cache_label: str = "sig"):
+        super().__init__(max_entries, cache_label=cache_label)
 
     @staticmethod
     def _parts(kind: str, data: Tuple) -> Tuple[bytes, ...]:
@@ -150,8 +213,8 @@ class ScriptExecutionCache(_SaltedLRU):
     spent-outputs digest) — validation.cpp:1529-1536 reshaped to the
     per-input batch API."""
 
-    def __init__(self, max_entries: int = 1 << 15):
-        super().__init__(max_entries)
+    def __init__(self, max_entries: int = 1 << 15, cache_label: str = "script"):
+        super().__init__(max_entries, cache_label=cache_label)
 
     @staticmethod
     def _parts(

@@ -46,6 +46,8 @@ from ..core.script import (
 from ..core.tx import COIN, MAX_MONEY, OutPoint, Tx, TxOut
 from ..core.tx_check import WITNESS_SCALE_FACTOR
 from ..crypto.jax_backend import TpuSecpVerifier
+from ..obs import counter as _obs_counter
+from ..obs import span as _span
 from ..utils.gcpause import gc_paused
 from .batch import BatchItem, BatchResult, verify_batch
 from .sigcache import ScriptExecutionCache, SigCache
@@ -63,6 +65,17 @@ __all__ = [
 
 COINBASE_MATURITY = 100  # consensus/consensus.h:19
 SUBSIDY_HALVING_INTERVAL = 210_000  # chainparams.cpp mainnet
+
+# Block-level telemetry (README "Observability"). The reason label reuses
+# the reference's reject strings ("bad-txns-in-belowout", ...) verbatim.
+_BLOCKS = _obs_counter(
+    "consensus_blocks_total", "connect_block calls by result", ("result",)
+)
+_BLOCK_REJECTS = _obs_counter(
+    "consensus_block_reject_total",
+    "connect_block rejections by reason string",
+    ("reason",),
+)
 
 
 @dataclass
@@ -212,20 +225,26 @@ def connect_block(
     """
     from .. import native_bridge
 
-    with gc_paused():
+    with gc_paused(), _span("block.connect", height=height):
         if (
             isinstance(coins, native_bridge.NativeCoinsView)
             and native_bridge.available()
         ):
-            return _connect_block_native(
+            res = _connect_block_native(
                 block, coins, height, flags, verifier, check_pow,
                 check_scripts, enforce_witness_commitment, pow_limit,
                 sig_cache, script_cache,
             )
-        return _connect_block_impl(
-            block, coins, height, flags, verifier, check_pow, check_scripts,
-            enforce_witness_commitment, pow_limit, sig_cache, script_cache,
-        )
+        else:
+            res = _connect_block_impl(
+                block, coins, height, flags, verifier, check_pow,
+                check_scripts, enforce_witness_commitment, pow_limit,
+                sig_cache, script_cache,
+            )
+    _BLOCKS.inc(result="ok" if res.ok else "reject")
+    if not res.ok and res.reason:
+        _BLOCK_REJECTS.inc(reason=res.reason)
+    return res
 
 
 def _connect_block_native(

@@ -1,0 +1,180 @@
+"""Nestable tracing spans over the verify pipeline's host path.
+
+A span is a context manager timing one named region with monotonic
+timestamps (`time.perf_counter`). Spans nest per thread; each records its
+parent, so a JSONL sink reconstructs the call tree of a verify:
+
+    block.connect
+      batch.verify_batch
+        batch.prepare
+        batch.interpret
+        batch.resolve
+          verifier.host_prep
+          verifier.dispatch
+          verifier.sync
+
+Every span aggregates into the process-global metrics registry:
+`consensus_span_duration_seconds{span=...}` (histogram — its `_count` is
+the call count) and `consensus_span_errors_total{span=...}` when the body
+raised. With no sink attached that aggregation is the ONLY exit-path work
+— no dict/JSON construction — so instrumentation stays on by default.
+Attach a `JsonlSink` (or anything with a `write(record: dict)` method) to
+additionally stream one JSON line per span.
+
+This module is the one sanctioned clock reader of the pipeline: the host
+AST lint (`analysis/host_lint.py`) rejects direct `time.perf_counter()`
+timing in `models/` and `crypto/` so all timing flows through here, and
+nothing in this module is ever traced into a device kernel.
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import os
+import threading
+import time
+from contextlib import contextmanager
+from typing import IO, Optional, Tuple, Union
+
+from .metrics import counter, histogram
+
+__all__ = ["Span", "JsonlSink", "add_sink", "remove_sink", "span"]
+
+_SPAN_SECONDS = histogram(
+    "consensus_span_duration_seconds",
+    "wall-clock duration of pipeline spans (see README span taxonomy)",
+    ("span",),
+)
+_SPAN_ERRORS = counter(
+    "consensus_span_errors_total",
+    "spans whose body raised",
+    ("span",),
+)
+
+_ids = itertools.count(1)  # next() is atomic under the GIL
+_tls = threading.local()
+
+# Sinks are kept in an immutable tuple swapped under a lock: the span exit
+# fast path reads one module global, no lock.
+_sinks: Tuple[object, ...] = ()
+_sinks_lock = threading.Lock()
+
+
+class Span:
+    """One timed region. `duration_s` is set when the region exits."""
+
+    __slots__ = ("name", "span_id", "parent_id", "t0", "duration_s", "attrs",
+                 "error")
+
+    def __init__(self, name: str, span_id: int, parent_id: Optional[int],
+                 attrs: Optional[dict]):
+        self.name = name
+        self.span_id = span_id
+        self.parent_id = parent_id
+        self.t0 = 0.0
+        self.duration_s: Optional[float] = None
+        self.attrs = attrs
+        self.error: Optional[str] = None
+
+
+class JsonlSink:
+    """Append-mode JSON-lines span sink (one dict per line), thread-safe."""
+
+    def __init__(self, path_or_file: Union[str, IO[str]]):
+        if isinstance(path_or_file, str):
+            self._fh = open(path_or_file, "a", encoding="utf-8")
+            self._owns = True
+        else:
+            self._fh = path_or_file
+            self._owns = False
+        self._lock = threading.Lock()
+
+    def write(self, record: dict) -> None:
+        line = json.dumps(record, separators=(",", ":"), sort_keys=True)
+        with self._lock:
+            self._fh.write(line + "\n")
+
+    def flush(self) -> None:
+        with self._lock:
+            self._fh.flush()
+
+    def close(self) -> None:
+        with self._lock:
+            self._fh.flush()
+            if self._owns:
+                self._fh.close()
+
+
+def add_sink(sink) -> None:
+    """Attach a span sink (any object with `write(record: dict)`)."""
+    global _sinks
+    with _sinks_lock:
+        _sinks = _sinks + (sink,)
+
+
+def remove_sink(sink) -> None:
+    global _sinks
+    with _sinks_lock:
+        _sinks = tuple(s for s in _sinks if s is not sink)
+
+
+def _stack() -> list:
+    st = getattr(_tls, "stack", None)
+    if st is None:
+        st = _tls.stack = []
+    return st
+
+
+@contextmanager
+def span(name: str, **attrs):
+    """Time a region as `name`; nest freely; yields the live Span.
+
+    Exceptions propagate untouched (recorded as `error` on the span and in
+    `consensus_span_errors_total`). Extra keyword attrs ride along into
+    sink records only — they never become metric labels, so attr
+    cardinality cannot pollute the registry.
+    """
+    stack = _stack()
+    parent = stack[-1] if stack else None
+    sp = Span(
+        name,
+        next(_ids),
+        parent.span_id if parent is not None else None,
+        attrs or None,
+    )
+    stack.append(sp)
+    sp.t0 = time.perf_counter()
+    try:
+        yield sp
+    except BaseException as e:
+        sp.error = type(e).__name__
+        raise
+    finally:
+        dt = time.perf_counter() - sp.t0
+        sp.duration_s = dt
+        stack.pop()
+        _SPAN_SECONDS.observe(dt, span=name)
+        if sp.error is not None:
+            _SPAN_ERRORS.inc(span=name)
+        sinks = _sinks
+        if sinks:
+            record = {
+                "name": name,
+                "span_id": sp.span_id,
+                "parent_id": sp.parent_id,
+                "thread": threading.get_ident(),
+                "pid": os.getpid(),
+                "t0": round(sp.t0, 9),
+                "dur_s": round(dt, 9),
+            }
+            if sp.attrs:
+                record["attrs"] = sp.attrs
+            if sp.error is not None:
+                record["error"] = sp.error
+            for s in sinks:
+                try:
+                    s.write(record)
+                except Exception:
+                    # A broken sink must never take down a verify.
+                    pass

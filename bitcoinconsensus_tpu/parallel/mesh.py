@@ -46,8 +46,25 @@ _SHARD_MAP_KW = (
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..crypto.jax_backend import SigCheck, TpuSecpVerifier, _verify_kernel
+from ..obs import counter as _obs_counter
+from ..obs import gauge as _obs_gauge
+from ..obs import histogram as _obs_histogram
 
 __all__ = ["make_mesh", "ShardedSecpVerifier", "make_sharded_step"]
+
+# Mesh telemetry — host-side driver accounting only; `local_step` below is
+# traced and must stay instrumentation-free.
+_MESH_DEVICES = _obs_gauge(
+    "consensus_mesh_devices", "devices in the sharded verifier's mesh"
+)
+_MESH_DISPATCH = _obs_counter(
+    "consensus_mesh_dispatch_total", "sharded (multi-chip) dispatches"
+)
+_MESH_SHARD_LANES = _obs_histogram(
+    "consensus_mesh_shard_lanes",
+    "per-device shard size (lanes) of each sharded dispatch",
+    buckets=(8, 64, 512, 4096, 32768),
+)
 
 
 def make_mesh(n_devices: Optional[int] = None, axis: str = "batch") -> Mesh:
@@ -149,10 +166,15 @@ class ShardedSecpVerifier(TpuSecpVerifier):
         )
         self._verdict_acc = True
         self._dispatched = 0
+        _MESH_DEVICES.set(n)
 
     def _run_kernel(self, args, n: int):
-        live = np.zeros(args[-1].shape[0], dtype=bool)
+        padded = int(args[-1].shape[0])
+        live = np.zeros(padded, dtype=bool)
         live[:n] = True
+        self._note_dispatch(padded, n, "mesh")
+        _MESH_DISPATCH.inc()
+        _MESH_SHARD_LANES.observe(padded // self.mesh.devices.size)
         per_lane, needs, all_ok = self._step(*args, live)
         self._verdict_acc = self._verdict_acc and bool(all_ok)
         self._dispatched += n

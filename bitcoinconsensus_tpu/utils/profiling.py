@@ -1,35 +1,45 @@
-"""Per-phase wall-clock timers for the verify pipeline.
+"""Per-phase wall-clock timers — a thin adapter over the obs telemetry.
 
-The reference ships no tracing at all (SURVEY §5); its bench layer is
-nanobench harnesses. Our pipeline crosses a host→device boundary, so the
-first profiling question is always attribution: host parse vs limb pack vs
-device dispatch vs readback. A `Phases` object accumulates seconds per
-named phase across calls; `TpuSecpVerifier` keeps one (see
-`crypto/jax_backend.py`) and `report()` summarises it.
+Historically `Phases` owned its own perf_counter pairs and bare dicts;
+the dict read-modify-writes raced under the `_idx_threads()` worker pool
+in `models/batch.py` (two threads could each read `_calls["x"] == 3` and
+both write 4). It is now a facade over `bitcoinconsensus_tpu.obs`:
 
-Usage:
+- each phase runs inside an obs span named ``<scope>.<name>`` — so every
+  `Phases` user feeds the global metrics registry
+  (`consensus_span_duration_seconds{span="verifier.dispatch"}` etc.) and
+  any attached JSONL sink for free;
+- the per-instance accumulation that `report()`/`total()` serve is kept,
+  but under a lock (regression-tested by tests/test_obs.py hammering one
+  instance from many threads).
+
+Usage is unchanged:
     ph = Phases()
     with ph("prep"):
         ...
     ph.report()  # {"prep": {"secs": ..., "calls": ...}, ...}
 
-Timers are cheap (two perf_counter calls) but not free; they are on by
-default because one batch is thousands of signatures — the per-batch
-overhead is noise. `Phases(enabled=False)` turns them into no-ops.
+`Phases(enabled=False)` turns them into no-ops. `reset()` clears only the
+instance's dicts — the cumulative registry metrics are process-global by
+design (reset those via obs.get_registry().reset()).
 """
 
 from __future__ import annotations
 
-import time
+import threading
 from contextlib import contextmanager
 from typing import Dict
+
+from ..obs import spans as _spans
 
 __all__ = ["Phases", "xla_trace"]
 
 
 class Phases:
-    def __init__(self, enabled: bool = True):
+    def __init__(self, enabled: bool = True, scope: str = "verifier"):
         self.enabled = enabled
+        self.scope = scope
+        self._lock = threading.Lock()
         self._secs: Dict[str, float] = {}
         self._calls: Dict[str, int] = {}
 
@@ -38,26 +48,31 @@ class Phases:
         if not self.enabled:
             yield
             return
-        t0 = time.perf_counter()
+        sp = None
         try:
-            yield
+            with _spans.span(f"{self.scope}.{name}") as sp:
+                yield
         finally:
-            dt = time.perf_counter() - t0
-            self._secs[name] = self._secs.get(name, 0.0) + dt
-            self._calls[name] = self._calls.get(name, 0) + 1
+            if sp is not None and sp.duration_s is not None:
+                with self._lock:
+                    self._secs[name] = self._secs.get(name, 0.0) + sp.duration_s
+                    self._calls[name] = self._calls.get(name, 0) + 1
 
     def reset(self) -> None:
-        self._secs.clear()
-        self._calls.clear()
+        with self._lock:
+            self._secs.clear()
+            self._calls.clear()
 
     def report(self) -> Dict[str, Dict[str, float]]:
-        return {
-            k: {"secs": round(self._secs[k], 6), "calls": self._calls[k]}
-            for k in self._secs
-        }
+        with self._lock:
+            return {
+                k: {"secs": round(self._secs[k], 6), "calls": self._calls[k]}
+                for k in self._secs
+            }
 
     def total(self) -> float:
-        return sum(self._secs.values())
+        with self._lock:
+            return sum(self._secs.values())
 
 
 @contextmanager
@@ -65,7 +80,7 @@ def xla_trace(log_dir: str = "/tmp/bitcoinconsensus_tpu_trace"):
     """XLA/TPU profiler hook: wraps a region in `jax.profiler.trace` so
     device-side timing (kernel occupancy, transfers) lands in a
     TensorBoard-readable trace under `log_dir`. Complements the host-side
-    `Phases` attribution; used by `scripts/profile_verify.py --xla-trace`."""
+    span attribution; used by `scripts/profile_verify.py --xla-trace`."""
     import jax
 
     with jax.profiler.trace(log_dir):

@@ -50,7 +50,7 @@ def main() -> int:
             print(f"{s.name:40s} {'heavy' if s.heavy else ''}")
         return 0
 
-    print("== host lint (core/, models/) ==")
+    print("== host lint (core/, models/ + crypto/ timing rule) ==")
     findings = host_lint.lint_consensus_host(REPO)
     for f in findings:
         print(f"  {f}")

@@ -1,0 +1,251 @@
+#!/usr/bin/env python
+"""Run a deterministic verify workload and expose the metrics snapshot.
+
+The observability counterpart of `scripts/consensus_lint.py`: where the
+lint proves static properties of the kernels, this proves the telemetry
+layer end to end — every pipeline layer (api, batch driver, sig/script
+caches, device dispatch, mesh, block connect) must light up its metrics
+on a small deterministic workload, or CI's `obs-smoke` job fails.
+
+Usage:
+    python scripts/consensus_stats.py                       # mini workload, JSON to stdout
+    python scripts/consensus_stats.py --format prom         # Prometheus text
+    python scripts/consensus_stats.py --out snap.json       # also write the doc
+    python scripts/consensus_stats.py --check               # exit 1 on missing/NaN metrics
+    python scripts/consensus_stats.py --diff old.json       # delta vs an earlier snapshot
+    python scripts/consensus_stats.py --jsonl-sink spans.jsonl   # stream span records
+
+`--workload none` skips the workload and snapshots whatever the process
+already accumulated (useful under `python -i` or after importing from a
+driver). The mini workload is seeded/deterministic: same inputs, same
+counter values, modulo timing histograms.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# The mesh leg of the workload wants >1 CPU device; must be set before
+# jax initializes. 8 matches tests/conftest.py so this script shares the
+# suite's persistent XLA compile cache (topology is part of the cache
+# key — a different device count means minutes of recompiles).
+if "XLA_FLAGS" not in os.environ:
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+
+# Every metric name the mini workload must light up, by layer. This list
+# is the CI contract: a refactor that silently drops an instrumentation
+# point fails `--check` before it ships.
+REQUIRED_METRICS = [
+    # api layer
+    "consensus_verify_calls_total",
+    "consensus_verify_reject_total",
+    "consensus_script_reject_total",
+    # batch driver
+    "consensus_batch_size",
+    "consensus_batch_items_total",
+    "consensus_batch_results_total",
+    "consensus_fixpoint_rounds",
+    "consensus_uniq_checks_total",
+    # caches
+    "consensus_cache_lookups_total",
+    "consensus_cache_hits_total",
+    "consensus_cache_misses_total",
+    "consensus_cache_insertions_total",
+    "consensus_cache_entries",
+    # device dispatch
+    "consensus_checks_total",
+    "consensus_dispatch_total",
+    "consensus_dispatch_lanes_total",
+    "consensus_dispatch_padded_lanes_total",
+    "consensus_dispatch_fill_ratio",
+    "consensus_dispatch_new_shapes_total",
+    # mesh
+    "consensus_mesh_devices",
+    "consensus_mesh_dispatch_total",
+    "consensus_mesh_shard_lanes",
+    # block connect
+    "consensus_blocks_total",
+    "consensus_block_reject_total",
+    # spans
+    "consensus_span_duration_seconds",
+]
+
+
+def run_mini_workload() -> None:
+    """Deterministic workload touching every instrumented layer.
+
+    Success and failure paths both: the reject-reason counters keyed by
+    `Error` / `ScriptError` code are part of the CI contract.
+    """
+    from bitcoinconsensus_tpu import api
+    from bitcoinconsensus_tpu.core.flags import VERIFY_ALL_EXTENDED
+    from bitcoinconsensus_tpu.crypto.jax_backend import SigCheck
+    from bitcoinconsensus_tpu.models.batch import BatchItem, verify_batch
+    from bitcoinconsensus_tpu.models.validate import connect_block
+    from bitcoinconsensus_tpu.parallel.mesh import (
+        ShardedSecpVerifier,
+        make_mesh,
+    )
+    from bitcoinconsensus_tpu.utils import blockgen
+
+    def expect(code, fn, *args, **kw):
+        try:
+            fn(*args, **kw)
+        except api.ConsensusError as e:
+            assert e.code == code, f"expected {code.name}, got {e.code.name}"
+        else:
+            raise AssertionError(f"expected {code.name}, got success")
+
+    # --- api layer: one success per entry point + one of each reject ---
+    view, funded = blockgen.make_funded_view(8, seed="stats")
+    tx = blockgen.build_spend_tx(funded[:4])
+    raw = tx.serialize()
+    outs = [(f.amount, f.wallet.spk) for f in funded[:4]]
+    api.verify_with_spent_outputs(raw, 0, outs)
+    pk_fund = [f for f in funded if f.wallet.kind == "p2pkh"][0]
+    pk_tx = blockgen.build_spend_tx([pk_fund])
+    api.verify(pk_fund.wallet.spk, pk_fund.amount, pk_tx.serialize(), 0)
+    api.verify_with_flags(
+        pk_fund.wallet.spk, pk_fund.amount, pk_tx.serialize(), 0, 0
+    )
+    expect(api.Error.ERR_TX_DESERIALIZE, api.verify, b"\x51", 0, b"junk", 0)
+    expect(
+        api.Error.ERR_INVALID_FLAGS,
+        api.verify_with_flags, b"\x51", 0, raw, 0, 1 << 30,
+    )
+    expect(api.Error.ERR_TX_INDEX, api.verify_with_spent_outputs, raw, 99, outs)
+    bad_tx = blockgen.build_spend_tx(funded[:4], corrupt_input=1)
+    expect(
+        api.Error.ERR_SCRIPT,
+        api.verify_with_spent_outputs, bad_tx.serialize(), 1,
+        outs,
+    )
+
+    # --- batch driver + caches + device dispatch: mixed batch, one bad
+    # input, then an identical replay for the cache-hit counters ---
+    items = [
+        BatchItem(raw, i, VERIFY_ALL_EXTENDED, spent_outputs=outs)
+        for i in range(4)
+    ]
+    bad_raw = bad_tx.serialize()
+    items.append(
+        BatchItem(bad_raw, 1, VERIFY_ALL_EXTENDED, spent_outputs=outs)
+    )
+    for _pass in range(2):
+        res = verify_batch(items)
+        assert [r.ok for r in res] == [True] * 4 + [False]
+
+    # --- block connect: one valid block, one failing replay ---
+    bview, bfunded = blockgen.make_funded_view(4, height=1, seed="stats-blk")
+    good = blockgen.build_spend_tx(bfunded, fee=2000)
+    blk = blockgen.build_block([good], height=200, fees=2000)
+    r = connect_block(blk, bview, 200, check_pow=False)
+    assert r.ok, r.reason
+    r2 = connect_block(blk, bview, 200, check_pow=False)  # inputs now spent
+    assert not r2.ok
+
+    # --- mesh: a sharded dispatch over the (virtual) device mesh ---
+    sv = ShardedSecpVerifier(mesh=make_mesh())
+    w = blockgen.Wallet("stats-mesh", "p2wpkh")
+    import hashlib
+
+    msg = hashlib.sha256(b"stats-mesh-msg").digest()
+    from bitcoinconsensus_tpu.crypto import secp_host as H
+
+    sig = H.sign_ecdsa(w.sk, msg)
+    checks = [SigCheck("ecdsa", (w.pub, sig, msg))] * 4
+    res, verdict = sv.verify_checks_with_verdict(checks)
+    assert verdict and res.all()
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument(
+        "--workload", choices=("mini", "none"), default="mini",
+        help="workload to run before snapshotting (default: mini)",
+    )
+    ap.add_argument(
+        "--format", choices=("json", "prom"), default="json",
+        help="stdout exposition format (default: json)",
+    )
+    ap.add_argument("--out", help="also write the JSON document to this path")
+    ap.add_argument(
+        "--check", action="store_true",
+        help="validate the snapshot (required metrics present with "
+        "samples, no NaN/inf); exit 1 on problems",
+    )
+    ap.add_argument(
+        "--diff", metavar="OLD_JSON",
+        help="print per-metric deltas against an earlier --out document",
+    )
+    ap.add_argument(
+        "--jsonl-sink", metavar="PATH",
+        help="stream span records (JSON lines) to this file during the run",
+    )
+    args = ap.parse_args(argv)
+
+    from bitcoinconsensus_tpu.obs import (
+        JsonlSink,
+        add_sink,
+        get_registry,
+        remove_sink,
+    )
+    from bitcoinconsensus_tpu.obs.exposition import (
+        diff_snapshots,
+        snapshot_to_json,
+        to_prometheus_text,
+        validate_snapshot,
+    )
+
+    sink = None
+    if args.jsonl_sink:
+        sink = JsonlSink(args.jsonl_sink)
+        add_sink(sink)
+    try:
+        if args.workload == "mini":
+            run_mini_workload()
+    finally:
+        if sink is not None:
+            remove_sink(sink)
+            sink.close()
+
+    snap = get_registry().snapshot()
+    doc = snapshot_to_json(snap, workload=args.workload)
+
+    if args.out:
+        with open(args.out, "w", encoding="utf-8") as fh:
+            fh.write(doc + "\n")
+
+    if args.diff:
+        with open(args.diff, encoding="utf-8") as fh:
+            old = json.load(fh)["metrics"]
+        lines = diff_snapshots(old, snap)
+        print("\n".join(lines) if lines else "(no differences)")
+    elif args.format == "prom":
+        sys.stdout.write(to_prometheus_text(snap))
+    else:
+        print(doc)
+
+    if args.check:
+        required = REQUIRED_METRICS if args.workload == "mini" else ()
+        problems = validate_snapshot(snap, required)
+        with_samples = [n for n in snap if snap[n]["samples"]]
+        print(
+            f"# {len(with_samples)} metrics with samples, "
+            f"{len(problems)} problems",
+            file=sys.stderr,
+        )
+        if problems:
+            for p in problems:
+                print(f"PROBLEM: {p}", file=sys.stderr)
+            return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

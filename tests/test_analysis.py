@@ -168,7 +168,25 @@ def test_host_lint_flags_violations(tmp_path):
             "time-dependence"} <= rules
 
 
+def test_host_lint_timing_rules_subset(tmp_path):
+    # crypto/ is scanned with TIMING_RULES only: floats and `/` are fine
+    # there (jax config, fill ratios), but ad-hoc clock reads must still
+    # be flagged — all timing flows through obs spans.
+    p = tmp_path / "driver.py"
+    p.write_text(
+        "x = 0.5\n"
+        "ratio = 3 / 4\n"
+        "t0 = time.perf_counter()\n"
+    )
+    findings = host_lint.lint_paths([str(p)], rules=host_lint.TIMING_RULES)
+    assert [f.rule for f in findings] == ["time-dependence"]
+    assert findings[0].line == 3
+    assert "obs spans" in findings[0].msg
+
+
 def test_host_lint_clean_on_consensus_path():
+    # Covers crypto/ (timing rule) as well as core/ + models/ (full rules):
+    # the instrumented pipeline itself must satisfy its own lint.
     repo = os.path.dirname(os.path.dirname(os.path.dirname(
         os.path.abspath(host_lint.__file__))))
     assert host_lint.lint_consensus_host(repo) == []

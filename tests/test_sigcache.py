@@ -101,3 +101,44 @@ def test_lru_bound():
     assert len(sig) == 4
     assert sig.contains_check("ecdsa", (b"pk9", b"sig", b"m"))
     assert not sig.contains_check("ecdsa", (b"pk0", b"sig", b"m"))
+
+
+def test_registry_metrics_mirror_cache_counters():
+    """The labeled registry children must track the legacy attrs exactly,
+    and the documented invariants must hold: hits + misses == lookups and
+    insertions - evictions - erases == len(cache)."""
+    import os
+
+    from bitcoinconsensus_tpu.obs import get_registry
+
+    label = "invtest-" + os.urandom(4).hex()  # isolate registry children
+    reg = get_registry()
+
+    def m(name):
+        metric = reg.get(f"consensus_cache_{name}")
+        return metric.value(cache=label)
+
+    sig = SigCache(max_entries=4, cache_label=label)
+    for i in range(10):
+        sig.add_check("ecdsa", (b"pk%d" % i, b"sig", b"m"))
+    assert m("insertions_total") == sig.insertions == 10
+    assert m("evictions_total") == sig.evictions == 6
+    assert m("entries") == len(sig) == 4
+
+    for i in range(10):
+        hit = sig.contains_check("ecdsa", (b"pk%d" % i, b"sig", b"m"))
+        assert hit == (i >= 6)  # pk6..pk9 survived the LRU bound
+    assert m("lookups_total") == 10
+    assert m("hits_total") == sig.hits == 4
+    assert m("misses_total") == sig.misses == 6
+    assert m("hits_total") + m("misses_total") == m("lookups_total")
+
+    # erase-on-hit (Core's mempool->block pattern) removes and counts.
+    assert sig.contains_check("ecdsa", (b"pk9", b"sig", b"m"), erase=True)
+    assert not sig.contains_check("ecdsa", (b"pk9", b"sig", b"m"))
+    assert m("erases_total") == sig.erases == 1
+    assert m("entries") == len(sig) == 3
+    assert (
+        m("insertions_total") - m("evictions_total") - m("erases_total")
+        == len(sig)
+    )
