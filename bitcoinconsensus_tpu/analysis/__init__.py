@@ -2,6 +2,9 @@
 
 `interval` — jaxpr-level interval abstract interpretation (the int32
 overflow prover) fused with the determinism/op-allowlist gate.
+`pallas_check` — the same engine pushed below the jaxpr into Pallas
+kernels: abstract Ref semantics, grid/BlockSpec checks, VMEM budget,
+ref-discipline lint (importing it registers the state-primitive rules).
 `registry` — the kernels the prover must certify, with their input
 contracts. `host_lint` — AST lint of the plain-Python consensus path.
 
@@ -18,3 +21,10 @@ from .interval import (  # noqa: F401
 )
 from .host_lint import LintFinding, lint_consensus_host, lint_paths  # noqa: F401
 from .registry import KernelSpec, all_kernels, get_kernel  # noqa: F401
+from .pallas_check import (  # noqa: F401
+    NEGATIVES,
+    RefAbstract,
+    VMEM_BUDGET_BYTES,
+    analyze_negative,
+    analyze_positive_toy,
+)

@@ -32,6 +32,19 @@ from typing import FrozenSet, Iterator, List, Sequence
 # Rule groups, selectable per scanned tree.
 ALL_RULES = frozenset({"float", "nondeterminism", "time"})
 TIMING_RULES = frozenset({"time"})
+# Pallas kernel-body discipline: inside `_kernel_body`, every limb
+# constant must come through the consts_ref row table installed by
+# `_kernel`'s set_const_provider — materializing an ndarray there makes
+# Mosaic bake it into the kernel as a captured constant, bypassing the
+# one audited constant path (analysis/pallas_check.py flags the same
+# thing at the jaxpr level; this catches it at review time, pre-trace).
+PALLAS_RULES = frozenset({"pallas"})
+
+# Function bodies subject to the `pallas` rule.
+PALLAS_KERNEL_BODIES = {"_kernel_body"}
+# np/jnp constructors that materialize array constants.
+ARRAY_CONSTRUCTORS = {"asarray", "array", "frombuffer", "fromiter"}
+ARRAY_MODULES = {"np", "numpy", "jnp"}
 
 BANNED_IMPORTS = {"random", "secrets"}
 # module.attr calls whose mere presence is a violation
@@ -65,6 +78,7 @@ class _Visitor(ast.NodeVisitor):
         self.path = path
         self.rules = rules
         self.findings: List[LintFinding] = []
+        self._fn_stack: List[str] = []
 
     def _flag(self, node, rule, msg):
         self.findings.append(
@@ -93,8 +107,36 @@ class _Visitor(ast.NodeVisitor):
                        f"import from `{node.module}` (entropy source)")
         self.generic_visit(node)
 
+    def visit_FunctionDef(self, node: ast.FunctionDef):
+        self._fn_stack.append(node.name)
+        try:
+            self.generic_visit(node)
+        finally:
+            self._fn_stack.pop()
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def _in_kernel_body(self) -> bool:
+        return any(n in PALLAS_KERNEL_BODIES for n in self._fn_stack)
+
     def visit_Call(self, node: ast.Call):
         fn = node.func
+        if "pallas" in self.rules and self._in_kernel_body():
+            name = None
+            if (isinstance(fn, ast.Attribute)
+                    and isinstance(fn.value, ast.Name)
+                    and fn.value.id in ARRAY_MODULES
+                    and fn.attr in ARRAY_CONSTRUCTORS):
+                name = f"{fn.value.id}.{fn.attr}"
+            elif isinstance(fn, ast.Name) and fn.id in ARRAY_CONSTRUCTORS:
+                name = fn.id
+            if name is not None:
+                self._flag(
+                    node, "pallas-consts",
+                    f"{name}() inside a Pallas kernel body captures an "
+                    "array constant — route limb constants through the "
+                    "consts_ref row table (limbs.set_const_provider), the "
+                    "one audited constant path into VMEM")
         if (
             "time" in self.rules
             and isinstance(fn, ast.Attribute)
@@ -155,9 +197,12 @@ def lint_paths(
 def lint_consensus_host(repo_root: str) -> List[LintFinding]:
     """Full rules over core/ + models/; clock rule alone over crypto/
     (its device-dispatch driver may use floats but must route timing
-    through obs spans, never raw perf_counter pairs)."""
+    through obs spans, never raw perf_counter pairs); const-provider
+    discipline over the Pallas kernel body."""
     pkg = os.path.join(repo_root, "bitcoinconsensus_tpu")
     findings = lint_paths([os.path.join(pkg, "core"),
                            os.path.join(pkg, "models")])
     findings += lint_paths([os.path.join(pkg, "crypto")], rules=TIMING_RULES)
+    findings += lint_paths([os.path.join(pkg, "ops", "pallas_kernel.py")],
+                           rules=PALLAS_RULES)
     return findings

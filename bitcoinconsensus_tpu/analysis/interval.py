@@ -284,6 +284,7 @@ def from_concrete(arr) -> AbstractArray:
 @dataclass
 class Violation:
     kind: str      # overflow | float | allowlist | dtype64 | loop | internal
+                   # | grid | ref | vmem (Pallas layer, pallas_check.py)
     where: str     # eqn path, e.g. "scan[3].body.eqn[17] mul"
     msg: str
 
@@ -302,12 +303,16 @@ class Report:
     wrap_eqns: int = 0      # signed ring ops whose interval left int32
     max_observed: int = 0   # largest |bound| proven at an observation
     notes: List[str] = field(default_factory=list)
+    # Pallas-layer facts (analysis/pallas_check.py): peak VMEM live set
+    # of the kernel (blocks + scratch + intermediates) and the grid shape.
+    vmem_peak_bytes: Optional[int] = None
+    grid: Optional[Tuple[int, ...]] = None
 
     def to_dict(self) -> dict:
         def b(v):  # saturated bounds -> JSON-safe
             return "unbounded" if abs(v) >= INF else int(v)
 
-        return {
+        d = {
             "kernel": self.name,
             "ok": self.ok,
             "violations": [
@@ -323,12 +328,23 @@ class Report:
             ],
             "notes": self.notes,
         }
+        if self.vmem_peak_bytes is not None:
+            d["vmem_peak_bytes"] = int(self.vmem_peak_bytes)
+        if self.grid is not None:
+            d["grid"] = [int(g) for g in self.grid]
+        return d
 
 
 class _Ctx:
     def __init__(self, report: Report):
         self.report = report
         self.mute = 0  # >0 during fixpoint warmup iterations
+        # >0 while evaluating a loop body (any _fixpoint pass, including
+        # the final unmuted one) or a multi-branch cond. Stateful rules
+        # (the Ref writes of analysis/pallas_check.py) must downgrade
+        # strong updates to hull-merges here: the body may abstract more
+        # than one concrete execution.
+        self.in_loop = 0
 
     def violate(self, kind: str, where: str, msg: str):
         if self.mute:
@@ -531,8 +547,17 @@ def _r_arith(interp, eqn, ins, where):
             return (min(ps), max(ps))
 
     nz0 = name == "mul" and (a.nz0 or b.nz0)
+    # Adding/subtracting a single constant shifts every row by the same
+    # amount: distinct constant rows stay distinct constant rows. (The
+    # Pallas G-loop builds its one-hot key as `broadcasted_iota + 1`,
+    # which must keep dist0 past ROW_CAP or the MXU select false-alarms.)
+    dist0 = False
+    if name in ("add", "sub"):
+        ja, jb = a.joined(), b.joined()
+        dist0 = ((a.dist0 and jb[0] == jb[1])
+                 or (b.dist0 and ja[0] == ja[1]))
     res = _ewise(interp.ctx, out.shape, out.dtype, ins, f,
-                 nz0=nz0, uni0=a.uni0 and b.uni0)
+                 nz0=nz0, uni0=a.uni0 and b.uni0, dist0=dist0)
     kind, bits = _dkind(out.dtype)
     if kind == "float":
         ok = _check_float_exact(interp, where, ins, res.joined())
@@ -1156,19 +1181,27 @@ def _r_reduce(interp, eqn, ins, where):
             return c
         return (c[0] * mult, c[1] * mult)
 
-    if a.nz0 and red0 and name == "reduce_sum":
+    if (a.nz0 and name == "reduce_sum" and 0 in axes
+            and (1 not in axes or a.r1 == 1)):
         # Masked-select: at most one element nonzero along axis 0, so the
         # sum is one of the rows (or 0) — join, don't sum. This is what
-        # keeps one-hot table selects at per-limb precision.
-        cells = [
-            [
-                (min(0, min(a.cells[i][j][0] for i in range(a.r0))),
-                 max(0, max(a.cells[i][j][1] for i in range(a.r0))))
-                for j in range(a.r1)
-            ]
+        # keeps one-hot table selects at per-limb precision. Applies even
+        # when the row grid is collapsed (r0 == 1: `mk` folds uniform
+        # rows, e.g. a W2-bounded table read through a Pallas Ref) — the
+        # single tracked cell covers every row, so the join is that cell
+        # extended with 0; only the OTHER reduced axes still multiply.
+        mult_no0 = 1
+        for ax in axes:
+            if ax == 0:
+                continue
+            mult_no0 *= a.shape[ax]
+        red0_cells = [
+            (min(0, min(a.cells[i][j][0] for i in range(a.r0))),
+             max(0, max(a.cells[i][j][1] for i in range(a.r0))))
+            for j in range(a.r1)
         ]
-        red0_cells = cells[0]
-        new_cells = [[apply_mult(c)] for c in red0_cells]
+        new_cells = [[(c[0] * mult_no0, c[1] * mult_no0)]
+                     for c in red0_cells]
         return [mk(out.shape, out.dtype, new_cells, exactf=a.exactf)]
 
     cells = a.cells
@@ -1345,6 +1378,7 @@ def _fixpoint(interp, closed, n_consts, consts_and_carry_init, extra_args,
     """
     const_in, carry0 = consts_and_carry_init
     carry = list(carry0)
+    interp.ctx.in_loop += 1
     interp.ctx.mute += 1
     try:
         for it in range(_MAX_FIX_ITERS):
@@ -1435,8 +1469,11 @@ def _fixpoint(interp, closed, n_consts, consts_and_carry_init, extra_args,
                 break
     finally:
         interp.ctx.mute -= 1
-    args = list(const_in) + list(carry) + list(extra_args)
-    outs = interp.eval_closed(closed, args, where)
+    try:
+        args = list(const_in) + list(carry) + list(extra_args)
+        outs = interp.eval_closed(closed, args, where)
+    finally:
+        interp.ctx.in_loop -= 1
     final_carry = []
     for old, new in zip(carry, outs[: len(carry)], strict=True):
         if min_trips >= 1:
@@ -1581,14 +1618,23 @@ def _r_cond(interp, eqn, ins, where):
     idxs = range(len(branches))
     if plo == phi and 0 <= plo < len(branches):
         idxs = [plo]
-    for bi in idxs:
-        bouts = interp.eval_closed(branches[bi], list(args),
-                                   f"{where}/branch{bi}")
-        if outs is None:
-            outs = list(bouts)
-        else:
-            outs = [join_values(a, b) if a.shape == b.shape else b
-                    for a, b in zip(outs, bouts, strict=True)]
+    # With an unresolved predicate every branch is evaluated abstractly
+    # but only one runs concretely — ref writes inside must stay weak.
+    multi = len(list(idxs)) > 1
+    if multi:
+        interp.ctx.in_loop += 1
+    try:
+        for bi in idxs:
+            bouts = interp.eval_closed(branches[bi], list(args),
+                                       f"{where}/branch{bi}")
+            if outs is None:
+                outs = list(bouts)
+            else:
+                outs = [join_values(a, b) if a.shape == b.shape else b
+                        for a, b in zip(outs, bouts, strict=True)]
+    finally:
+        if multi:
+            interp.ctx.in_loop -= 1
     return outs
 
 

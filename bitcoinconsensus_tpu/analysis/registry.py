@@ -170,8 +170,25 @@ def _specs() -> List[KernelSpec]:
                         _flags(B), _bools(B))),
             in_bounds={1: (0, 1), 2: (-1, 1), 3: (0, 1), 4: (0, 1),
                        5: (0, 1)},
+            out_within=[[(0, 1)] * DEFAULT_BATCH],
             heavy=True,
             note="the full device-side verify batch (~70k eqns)",
+        ),
+        KernelSpec(
+            "pallas.verify_tiles",
+            lambda B: _pallas_verify_build(),
+            # Flag contract single-sourced from ops/pallas_kernel.py
+            # (same shape as jax_backend.verify_kernel's); the limb
+            # contracts live below the byte-unpack preamble and are
+            # re-derived, not assumed.
+            in_bounds=_pallas_flag_bounds(),
+            # Two (B,) verdict vectors, each lane provably 0/1 — the same
+            # pin the XLA verify kernel carries, independently re-derived
+            # through the Mosaic kernel's Ref semantics.
+            out_within=[[(0, 1)] * _PALLAS_B] * 2,
+            heavy=True,
+            note="the fused Mosaic kernel: Ref-semantics interval proof + "
+                 "grid/BlockSpec + VMEM budget (analysis/pallas_check.py)",
         ),
     ]
     return specs
@@ -180,6 +197,33 @@ def _specs() -> List[KernelSpec]:
 def _verify_kernel_fn():
     from ..crypto import jax_backend as JB
     return JB._verify_kernel
+
+
+# verify_tiles requires B % LANE_TILE == 0 and a multi-step grid is the
+# interesting case, so the Pallas spec ignores the requested batch and
+# proves two full lane tiles.
+_PALLAS_B = 1024  # == 2 * ops.pallas_kernel.LANE_TILE
+
+
+def _pallas_flag_bounds():
+    from ..ops import pallas_kernel as PK
+    return dict(PK.FLAG_BOUNDS)
+
+
+def _pallas_verify_build():
+    from . import pallas_check  # noqa: F401  registers the Ref rules
+    from ..ops import pallas_kernel as PK
+
+    assert _PALLAS_B == 2 * PK.LANE_TILE
+    B = _PALLAS_B
+
+    def fn(fields, want_odd, parity_req, has_t2, neg1, neg2, valid):
+        return PK.verify_tiles(fields, want_odd, parity_req, has_t2,
+                               neg1, neg2, valid)
+
+    return fn, (jax.ShapeDtypeStruct((B, 4, 32), jnp.uint8),
+                _flags(B), _flags(B), _flags(B), _flags(B),
+                _flags(B), _bools(B))
 
 
 def all_kernels(include_heavy: bool = True) -> List[KernelSpec]:

@@ -76,9 +76,22 @@ from .limbs import (
     set_const_provider,
 )
 
-__all__ = ["verify_tiles", "LANE_TILE"]
+__all__ = ["verify_tiles", "LANE_TILE", "FLAG_BOUNDS", "OK_BOUNDS"]
 
 LANE_TILE = 512  # lanes per kernel instance (4 VPU lane groups)
+
+# Input/output contract of `verify_tiles`, single-sourced here and
+# consumed by analysis/registry (the prover assumes exactly this much of
+# the flag operands and must re-derive the verdict bounds below). Keys
+# are positional argument indices of `verify_tiles`.
+FLAG_BOUNDS = {
+    1: (0, 1),    # want_odd
+    2: (-1, 1),   # parity_req: -1 = don't care, else required parity
+    3: (0, 1),    # has_t2 (r+n secondary target exists)
+    4: (0, 1),    # neg1
+    5: (0, 1),    # neg2
+}
+OK_BOUNDS = (0, 1)  # both verdict vectors are 0/1 masks per lane
 
 # Signed 5-bit windows over the 128-bit GLV halves: 26 windows of
 # (5 doublings + 2 complete adds) instead of the XLA path's 32 x (4 + 2) —

@@ -5,12 +5,20 @@ deterministic, and lint the host-side consensus path.
     python scripts/consensus_lint.py            # everything (CI gate)
     python scripts/consensus_lint.py --quick    # skip heavy kernels
     python scripts/consensus_lint.py --kernel limbs.fe_mul
+    python scripts/consensus_lint.py --kernel pallas.verify_tiles
     python scripts/consensus_lint.py --report out.json
+    python scripts/consensus_lint.py --negative oob-index-map
 
 Exit status 0 iff every kernel proves clean AND the host lint is clean.
 The JSON report carries the derived per-limb output bounds of every
-kernel so reviewers can diff bounds across PRs (CI uploads it as a
-build artifact).
+kernel — plus, for Pallas kernels, the peak VMEM live set and grid —
+so reviewers can diff bounds across PRs (CI uploads it as a build
+artifact).
+
+`--negative NAME` runs one of the deliberately broken toy Pallas
+kernels from `analysis/pallas_check.NEGATIVES` and exits non-zero with
+its diagnostics: the gate proving it still fires. `--negative list`
+lists the available toys.
 """
 
 from __future__ import annotations
@@ -37,9 +45,26 @@ def main() -> int:
                     help="write the per-kernel bound report as JSON")
     ap.add_argument("--list", action="store_true",
                     help="list registered kernels and exit")
+    ap.add_argument("--negative", default=None, metavar="NAME",
+                    help="run one broken toy Pallas kernel (or `list`); "
+                         "exits non-zero with its diagnostics")
     args = ap.parse_args()
 
     from bitcoinconsensus_tpu.analysis import host_lint, registry
+
+    if args.negative:
+        from bitcoinconsensus_tpu.analysis import pallas_check
+        if args.negative == "list":
+            for n in sorted(pallas_check.NEGATIVES):
+                print(n)
+            return 0
+        rep = pallas_check.analyze_negative(args.negative)
+        print(f"negative toy `{args.negative}`: "
+              f"{'FAILED the gate (expected)' if not rep.ok else 'PROVED CLEAN (gate is dead!)'}")
+        for v in rep.violations:
+            print(f"  {v.kind:10s} {v.where}")
+            print(f"             {v.msg}")
+        return 1 if not rep.ok else 0
 
     specs = registry.all_kernels(include_heavy=not args.quick)
     if args.kernel:
@@ -73,8 +98,12 @@ def main() -> int:
         dt = time.time() - t0
         status = "PROVEN" if rep.ok else "FAIL"
         wraps = f" wraps={rep.wrap_eqns}" if rep.wrap_eqns else ""
+        vmem = ""
+        if rep.vmem_peak_bytes is not None:
+            vmem = (f" vmem={rep.vmem_peak_bytes / (1 << 20):.2f}MiB"
+                    f" grid={tuple(rep.grid) if rep.grid else ()}")
         print(f"  {spec.name:40s} {status}  eqns={rep.n_eqns}"
-              f" max|v|={rep.max_observed}{wraps}  ({dt:.1f}s)")
+              f" max|v|={rep.max_observed}{wraps}{vmem}  ({dt:.1f}s)")
         for v in rep.violations[:12]:
             print(f"      {v.kind:10s} {v.where}")
             print(f"                 {v.msg}")
