@@ -76,8 +76,17 @@ from ..ops.curve import (
 )
 from .glv import split_lambda
 from .secp_host import N, parse_der_lax
+from ..resilience import degrade as _degrade
+from ..resilience import faults as _faults
+from ..resilience import guards as _guards
 
 __all__ = ["SigCheck", "TpuSecpVerifier", "default_verifier"]
+
+_CONFIG_ERRORS = _obs_counter(
+    "consensus_backend_config_errors_total",
+    "backend/config setup steps that failed and were skipped",
+    ("step",),
+)
 
 # Persistent XLA compilation cache: the verify kernel is a large traced
 # program; caching makes every process after the first fast.
@@ -87,8 +96,11 @@ _CACHE_DIR = os.environ.get(
 try:  # pragma: no cover - depends on jax version/platform
     jax.config.update("jax_compilation_cache_dir", _CACHE_DIR)
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
-except Exception:
-    pass
+except (AttributeError, KeyError, ValueError, TypeError):
+    # An old/new jax may not know these keys; running without the
+    # persistent cache is slow-but-correct. Never silent, though: a
+    # backend-selection fault must be visible in the telemetry.
+    _CONFIG_ERRORS.inc(step="compilation_cache")
 
 # Device-dispatch telemetry (README "Observability"). All host-side: these
 # run in the driver around `jit` calls, never inside a traced program, so
@@ -425,6 +437,13 @@ class TpuSecpVerifier:
         # shape means one jit compile (or persistent-cache load).
         self._seen_shapes: set = set()
         self.phases = Phases()  # host_prep / pack / dispatch / sync
+        # Fault containment (resilience/): retry budget + backend
+        # quarantine ladder. `_dispatch_level` is the rung the in-flight
+        # dispatch runs at (set around each _run_kernel call).
+        self._resilience = _degrade.DispatchResilience(
+            self._ladder_levels(), name=type(self).__name__
+        )
+        self._dispatch_level: Optional[str] = None
 
     def _pad(self, n: int) -> int:
         size = self._min_batch
@@ -501,7 +520,7 @@ class TpuSecpVerifier:
             return self._verify_checks_impl(checks)
 
     def _verify_checks_impl(self, checks: Sequence[SigCheck]) -> np.ndarray:
-        pending = []  # (device_result, start, count)
+        pending = []  # (dispatch record, start, count)
         for start in range(0, len(checks), self._chunk):
             sub_checks = checks[start : start + self._chunk]
             if self._native is not None:
@@ -516,29 +535,158 @@ class TpuSecpVerifier:
                     args = self._pack_lanes(sub)
             with self.phases("dispatch"):
                 pending.append(
-                    (self._run_kernel(args, len(sub_checks)), start, len(sub_checks))
+                    (self._dispatch_guarded(args, len(sub_checks)), start,
+                     len(sub_checks))
                 )
         out = np.zeros(len(checks), dtype=bool)
         with self.phases("sync"):
-            for res, start, count in pending:
-                if isinstance(res, tuple):
-                    ok, needs = res
-                    out[start : start + count] = np.asarray(ok)[:count]
-                    needs_np = np.asarray(needs)[:count]
-                    if needs_np.any():
-                        # Exceptional group-law lanes (crafted scalar
-                        # collisions): the fast device adds deferred them;
-                        # resolve exactly on host (never hit by honest
-                        # traffic — tests/test_pallas_kernel.py crafts one).
-                        _HOST_FIXUPS.inc(int(needs_np.sum()))
-                        for i in np.nonzero(needs_np)[0]:
-                            r = self._host_check(checks[start + int(i)])
-                            out[start + int(i)] = r
-                            if not r:
-                                self._fixup_failed = True
-                else:
-                    out[start : start + count] = np.asarray(res)[:count]
+            for rec, start, count in pending:
+                self._settle_guarded(rec, checks, out, start, count)
         return out
+
+    # --- fault containment (resilience/) --------------------------------
+    #
+    # Every dispatch flows through _dispatch_guarded (pick ladder rung,
+    # seed sentinel lanes, catch dispatch-time faults) and settles through
+    # _settle_device (validate the verdict buffer, retry within budget,
+    # walk the quarantine ladder). A chunk no device rung could answer for
+    # lands on the host-exact oracle — faults cost latency, never a wrong
+    # ACCEPT, never a crash.
+
+    _SITE = "jax_backend"
+
+    def _ladder_levels(self) -> Tuple[str, ...]:
+        if self._use_pallas:
+            return ("pallas", "xla", _degrade.HOST_LEVEL)
+        return ("xla", _degrade.HOST_LEVEL)
+
+    def _run_level(self, args: Tuple, n: int, level: str):
+        self._dispatch_level = level
+        try:
+            return self._run_kernel(args, n)
+        finally:
+            self._dispatch_level = None
+
+    def _dispatch_guarded(self, args: Tuple, n: int) -> dict:
+        """Async-dispatch one packed chunk at the ladder's current rung."""
+        level, probe = self._resilience.ladder.pick_level()
+        rec = {
+            "args": args, "n": n, "level": level, "probe": probe,
+            "attempts": 1, "deadline": self._resilience.deadline(),
+            "sset": _guards.install_sentinels(args, n),
+            "result": None, "error": None,
+        }
+        if level == _degrade.HOST_LEVEL:
+            return rec
+        try:
+            rec["result"] = self._run_level(args, n, level)
+        except Exception as e:  # containment boundary: work lands on host
+            rec["error"] = e
+        return rec
+
+    def _materialize_guarded(self, rec: dict):
+        """Materialize + validate one dispatch record. Returns (ok, needs,
+        all_ok) — padded bool arrays and the sharded step's replicated
+        verdict scalar (None off-mesh). Raises VerdictAnomaly on a buffer
+        the guards reject."""
+        result = rec["result"]
+        padded = int(rec["args"][0].shape[0])
+        all_ok = None
+        needs_raw = None
+        if isinstance(result, tuple):
+            if len(result) == 3:
+                ok_raw, needs_raw, all_ok = result
+            else:
+                ok_raw, needs_raw = result
+        else:
+            ok_raw = result
+        ok_np = _faults.corrupt_verdict("jax_backend.verdict", np.asarray(ok_raw))
+        ok = _guards.validate_verdict(ok_np, padded, self._SITE)
+        needs = None
+        if needs_raw is not None:
+            needs = _guards.validate_verdict(
+                np.asarray(needs_raw), padded, self._SITE
+            )
+        _guards.check_sentinels(rec["sset"], ok, needs, self._SITE)
+        if all_ok is not None:
+            all_ok = bool(np.asarray(all_ok))
+        return ok, needs, all_ok
+
+    def _settle_device(self, rec: dict, count: int):
+        """Retry/degradation loop for one dispatched record: validate, on
+        any fault report the rung and retry within the budget (walking the
+        ladder as it demotes). Returns (ok, needs) padded arrays that
+        passed every guard, or None when the chunk must resolve on the
+        host-exact oracle (fail-closed terminal)."""
+        res = self._resilience
+        while rec["level"] != _degrade.HOST_LEVEL:
+            err = rec["error"]
+            if err is None:
+                try:
+                    ok, needs, all_ok = self._materialize_guarded(rec)
+                except Exception as e:  # VerdictAnomaly or runtime fault
+                    err = e
+                else:
+                    res.ladder.report(rec["level"], True, probe=rec["probe"])
+                    self._note_device_verdict(all_ok, ok, needs, count)
+                    return ok, needs
+            res.ladder.report(rec["level"], False, probe=rec["probe"])
+            if not res.may_retry(rec["attempts"], rec["deadline"], self._SITE):
+                break
+            rec["attempts"] += 1
+            rec["level"], rec["probe"] = res.ladder.pick_level()
+            if rec["level"] == _degrade.HOST_LEVEL:
+                break
+            rec["error"] = None
+            try:
+                rec["result"] = self._run_level(
+                    rec["args"], rec["n"], rec["level"]
+                )
+            except Exception as e:
+                rec["error"] = e
+        _guards.CONTAINED.inc(site=self._SITE)
+        _guards.HOST_EXACT_LANES.inc(count)
+        if res.ladder.current == _degrade.HOST_LEVEL:
+            # Settling on the bottom rung counts toward the re-promotion
+            # probe window (host itself cannot fail).
+            res.ladder.report(_degrade.HOST_LEVEL, True)
+        return None
+
+    def _settle_guarded(self, rec: dict, checks: Sequence[SigCheck],
+                        out: np.ndarray, start: int, count: int) -> None:
+        settled = self._settle_device(rec, count)
+        if settled is None:
+            host_res = np.fromiter(
+                (self._host_check(checks[start + i]) for i in range(count)),
+                dtype=bool, count=count,
+            )
+            out[start : start + count] = host_res
+            self._note_host_lanes(host_res)
+            return
+        ok, needs = settled
+        out[start : start + count] = ok[:count]
+        if needs is not None:
+            needs_np = needs[:count]
+            if needs_np.any():
+                # Exceptional group-law lanes (crafted scalar collisions):
+                # the fast device adds deferred them; resolve exactly on
+                # host (never hit by honest traffic —
+                # tests/test_pallas_kernel.py crafts one).
+                _HOST_FIXUPS.inc(int(needs_np.sum()))
+                for i in np.nonzero(needs_np)[0]:
+                    r = self._host_check(checks[start + int(i)])
+                    out[start + int(i)] = r
+                    if not r:
+                        self._fixup_failed = True
+
+    def _note_device_verdict(self, all_ok, ok, needs, count: int) -> None:
+        """Settle-time hook: a device chunk passed every guard. The base
+        verifier keeps no chunk-level verdict; the sharded subclass ANDs
+        into its block verdict here — at settle, so retries and contained
+        faults can never double- or mis-count."""
+
+    def _note_host_lanes(self, results: np.ndarray) -> None:
+        """Settle-time hook: a contained chunk resolved host-exact."""
 
     def pad(self, n: int) -> int:
         """Public pad-ladder size for `n` lanes (the index-mode batch
@@ -555,18 +703,22 @@ class TpuSecpVerifier:
         The index-mode driver's seam: lanes are prepped in the native
         session (uniq_lanes) so no SigCheck objects exist on this side."""
         with self.phases("dispatch"):
-            return self._run_kernel(args, n)
+            return self._dispatch_guarded(args, n)
 
     def sync_lanes(self, pending, n: int) -> Tuple[np.ndarray, Optional[np.ndarray]]:
         """Materialize a dispatch_lanes result: (ok[:n], needs_host[:n] or
         None). Lanes flagged needs_host hit an exceptional group-law case
-        (crafted scalar collisions); the caller must resolve them exactly
-        (nat_session_uniq_host_verify) — they report ok=False here."""
+        (crafted scalar collisions) OR a contained device fault; the
+        caller must resolve them exactly (nat_session_uniq_host_verify) —
+        they report ok=False here. A chunk no device rung could answer for
+        comes back with EVERY lane flagged needs_host (fail-closed: the
+        caller's exact oracle decides, a fault never yields an ACCEPT)."""
         with self.phases("sync"):
-            if isinstance(pending, tuple):
-                ok, needs = pending
-                return np.asarray(ok)[:n], np.asarray(needs)[:n]
-            return np.asarray(pending)[:n], None
+            settled = self._settle_device(pending, n)
+            if settled is None:
+                return np.zeros(n, dtype=bool), np.ones(n, dtype=bool)
+            ok, needs = settled
+            return ok[:n], (None if needs is None else needs[:n])
 
     def _host_check(self, chk: SigCheck) -> bool:
         """Host-exact resolution of one check (native core when present,
@@ -598,7 +750,9 @@ class TpuSecpVerifier:
             raw[pos + 64 : pos + 96] = lane.px.to_bytes(32, "little")
             raw[pos + 96 : pos + 128] = lane.t1.to_bytes(32, "little")
             pos += 128
-        fields = np.frombuffer(bytes(raw), dtype=np.uint8).reshape(size, 4, 32)
+        # View over the bytearray, not a bytes copy: the fields array must
+        # stay writable so install_sentinels can seed the pad region.
+        fields = np.frombuffer(raw, dtype=np.uint8).reshape(size, 4, 32)
 
         def flag(get, pad_value):
             arr = np.fromiter((get(l) for l in lanes), dtype=np.int32, count=n)
@@ -632,9 +786,11 @@ class TpuSecpVerifier:
         complete-add kernel) or an (ok, needs_host) tuple (pallas fast-add
         kernel; flagged lanes are resolved host-side in verify_checks)."""
         padded = int(args[0].shape[0])
-        if self._use_pallas:
+        _faults.maybe_raise("jax_backend.dispatch")
+        if self._use_pallas and self._dispatch_level != "xla":
             # Deferred import keeps CPU-only paths light; LANE_TILE is the
             # kernel's own tile so the guard cannot drift from its assert.
+            # A ladder-quarantined pallas rung skips straight to XLA.
             from ..ops.pallas_kernel import LANE_TILE, verify_tiles
 
             if padded % LANE_TILE == 0:

@@ -52,6 +52,8 @@ from .. import native_bridge
 from ..obs import counter as _obs_counter
 from ..obs import histogram as _obs_histogram
 from ..obs import span as _span
+from ..resilience import faults as _faults
+from ..resilience import guards as _guards
 from ..utils.gcpause import gc_paused
 from .sigcache import (
     ScriptExecutionCache,
@@ -423,13 +425,22 @@ def _resolve_uniq(nsess, verifier, sig_cache, state: _UniqState) -> None:
     keys = {int(i): raw[32 * j : 32 * j + 32] for j, i in enumerate(grow)}
     state.val = np.concatenate([state.val, np.zeros(U - lo, dtype=bool)])
 
-    if len(sig_cache) == 0:  # cold cache: every probe misses
-        miss = [int(i) for i in grow]
+    if len(sig_cache) == 0 and _faults.active() is None:
+        miss = [int(i) for i in grow]  # cold cache: every probe misses
     else:
+        audit = _guards.audit_cache_hits()
         miss = []
         for i in grow:
             if sig_cache.contains_key(keys[int(i)]):
-                state.val[i] = True
+                # Audit mode (resilience): a hit certifies a past success,
+                # but a poisoned entry certifies nothing — re-verify on
+                # the exact oracle and evict entries proven wrong.
+                if audit and not nsess.uniq_host_verify(int(i)):
+                    _guards.CACHE_POISON_CAUGHT.inc(cache="sig")
+                    sig_cache.discard_key(keys[int(i)])
+                    miss.append(int(i))
+                else:
+                    state.val[i] = True
             else:
                 miss.append(int(i))
     if miss:
@@ -754,14 +765,34 @@ def _verify_batch_impl(
                 # many deduplicated checks this batch actually discovered.
                 _UNIQ_CHECKS.inc(len(todo))
                 cache_keys = sig_cache.keys_for_checks(todo)
+                audit = _guards.audit_cache_hits()
                 fresh: List[Tuple[SigCheck, bytes]] = []
                 for chk, ck in zip(todo, cache_keys, strict=True):
                     if sig_cache.contains_key(ck):
-                        known[(chk.kind, chk.data)] = True
+                        # Audit mode (resilience): re-verify the hit on
+                        # the exact oracle; evict entries proven wrong.
+                        if audit and not verifier._host_check(chk):
+                            _guards.CACHE_POISON_CAUGHT.inc(cache="sig")
+                            sig_cache.discard_key(ck)
+                            fresh.append((chk, ck))
+                        else:
+                            known[(chk.kind, chk.data)] = True
                     else:
                         fresh.append((chk, ck))
                 if fresh:
-                    run_res = verifier.verify_checks([c for c, _ in fresh])
+                    fresh_checks = [c for c, _ in fresh]
+                    try:
+                        _faults.maybe_raise("batch.dispatch")
+                        run_res = verifier.verify_checks(fresh_checks)
+                    except Exception:
+                        # Driver-level dispatch fault: contain by resolving
+                        # every check on the host-exact oracle (fail-closed
+                        # — latency, never correctness).
+                        _guards.CONTAINED.inc(site="batch.dispatch")
+                        _guards.HOST_EXACT_LANES.inc(len(fresh_checks))
+                        run_res = [
+                            verifier._host_check(c) for c in fresh_checks
+                        ]
                     for (chk, ck), r in zip(fresh, run_res, strict=True):
                         known[(chk.kind, chk.data)] = bool(r)
                         if r:  # success-only insertion, like the reference

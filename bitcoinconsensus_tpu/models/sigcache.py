@@ -39,6 +39,7 @@ from typing import Iterable, Optional, Tuple
 
 from ..obs import counter as _obs_counter
 from ..obs import gauge as _obs_gauge
+from ..resilience import faults as _faults
 
 __all__ = [
     "SigCache",
@@ -80,6 +81,10 @@ class _SaltedLRU:
         assert max_entries > 0
         self._salt = os.urandom(32)
         self._max = max_entries
+        # Chaos-harness injection site (resilience/faults.py): an armed
+        # "poison" fault makes one probe report a fabricated hit, the
+        # observable a genuinely poisoned entry would produce.
+        self._poison_site = "sigcache." + cache_label
         self._set: OrderedDict[bytes, None] = OrderedDict()
         self._lock = threading.Lock()
         self.hits = 0
@@ -107,15 +112,21 @@ class _SaltedLRU:
 
     def contains_key(self, k: bytes, erase: bool = False) -> bool:
         """Probe by a precomputed digest (see SigCache.keys_for_checks)."""
+        poisoned = _faults.poison_hit(self._poison_site)
         with self._lock:
-            hit = k in self._set
-            if hit:
+            present = k in self._set
+            hit = present or poisoned
+            if present:
                 self.hits += 1
                 if erase:
                     del self._set[k]
                     self.erases += 1
                 else:
                     self._set.move_to_end(k)
+            elif poisoned:
+                # Fabricated hit, dict untouched: counted as a hit so the
+                # hits+misses==lookups invariant holds under chaos.
+                self.hits += 1
             else:
                 self.misses += 1
             size = len(self._set)
@@ -124,12 +135,27 @@ class _SaltedLRU:
         self._m_lookups.inc()
         if hit:
             self._m_hits.inc()
-            if erase:
+            if present and erase:
                 self._m_erases.inc()
                 self._m_entries.set(size)
         else:
             self._m_misses.inc()
         return hit
+
+    def discard_key(self, k: bytes) -> None:
+        """Drop a proven-wrong entry (resilience cache-audit containment).
+
+        No-op when absent. Counted as an erase so the entry-count
+        invariant (insertions - evictions - erases == entries) holds."""
+        with self._lock:
+            present = k in self._set
+            if present:
+                del self._set[k]
+                self.erases += 1
+            size = len(self._set)
+        if present:
+            self._m_erases.inc()
+            self._m_entries.set(size)
 
     def add_key(self, k: bytes) -> None:
         with self._lock:

@@ -41,7 +41,7 @@ from .metrics import (
     get_registry,
     histogram,
 )
-from .spans import JsonlSink, Span, add_sink, remove_sink, span
+from .spans import JsonlSink, Span, add_sink, monotonic, remove_sink, span
 
 __all__ = [
     "JsonlSink",
@@ -52,6 +52,7 @@ __all__ = [
     "gauge",
     "get_registry",
     "histogram",
+    "monotonic",
     "remove_sink",
     "span",
 ]

@@ -39,7 +39,7 @@ from typing import IO, Optional, Tuple, Union
 
 from .metrics import counter, histogram
 
-__all__ = ["Span", "JsonlSink", "add_sink", "remove_sink", "span"]
+__all__ = ["Span", "JsonlSink", "add_sink", "monotonic", "remove_sink", "span"]
 
 _SPAN_SECONDS = histogram(
     "consensus_span_duration_seconds",
@@ -51,6 +51,23 @@ _SPAN_ERRORS = counter(
     "spans whose body raised",
     ("span",),
 )
+_SINK_ERRORS = counter(
+    "consensus_obs_sink_errors_total",
+    "span records dropped because a sink's write() raised",
+    ("sink",),
+)
+
+
+def monotonic() -> float:
+    """Sanctioned monotonic clock for host-side *policy* code.
+
+    The resilience layer needs wall-clock deadlines (bounded retry) but is
+    linted with the clock rule like `crypto/` — direct `time.*` reads are
+    banned outside this module so ad-hoc timing cannot drift in beside the
+    telemetry. Policy deadlines read the clock through here; consensus
+    code (`core/`, `models/`) still may not read it at all.
+    """
+    return time.perf_counter()
 
 _ids = itertools.count(1)  # next() is atomic under the GIL
 _tls = threading.local()
@@ -176,5 +193,7 @@ def span(name: str, **attrs):
                 try:
                     s.write(record)
                 except Exception:
-                    # A broken sink must never take down a verify.
-                    pass
+                    # A broken sink must never take down a verify — but a
+                    # sink dying mid-chaos-run must not vanish without
+                    # trace either: every dropped record is counted.
+                    _SINK_ERRORS.inc(sink=type(s).__name__)

@@ -49,6 +49,8 @@ from ..crypto.jax_backend import SigCheck, TpuSecpVerifier, _verify_kernel
 from ..obs import counter as _obs_counter
 from ..obs import gauge as _obs_gauge
 from ..obs import histogram as _obs_histogram
+from ..resilience import degrade as _degrade
+from ..resilience import faults as _faults
 
 __all__ = ["make_mesh", "ShardedSecpVerifier", "make_sharded_step"]
 
@@ -168,17 +170,46 @@ class ShardedSecpVerifier(TpuSecpVerifier):
         self._dispatched = 0
         _MESH_DEVICES.set(n)
 
+    _SITE = "mesh"
+
+    def _ladder_levels(self):
+        # Quarantined mesh dispatch falls back to the single-device base
+        # kernel before host: a sick collective/device drop does not force
+        # host EC math while one chip still answers correctly.
+        return ("mesh", "xla", _degrade.HOST_LEVEL)
+
     def _run_kernel(self, args, n: int):
+        if self._dispatch_level == "xla":
+            # Ladder-quarantined mesh rung: single-device base dispatch.
+            return TpuSecpVerifier._run_kernel(self, args, n)
+        _faults.maybe_raise("mesh.dispatch")
         padded = int(args[-1].shape[0])
         live = np.zeros(padded, dtype=bool)
-        live[:n] = True
+        live[:n] = True  # sentinel/pad lanes stay out of the psum verdict
         self._note_dispatch(padded, n, "mesh")
         _MESH_DISPATCH.inc()
         _MESH_SHARD_LANES.observe(padded // self.mesh.devices.size)
-        per_lane, needs, all_ok = self._step(*args, live)
+        return self._step(*args, live)
+
+    def _note_device_verdict(self, all_ok, ok, needs, count: int) -> None:
+        """AND a settled chunk into the block verdict. `all_ok` is the
+        psum collective's replicated scalar for mesh dispatches; for
+        quarantined (single-device) dispatches it is recomputed from the
+        per-lane buffer with the same semantics (deferred lanes excluded —
+        the host fixup ANDs their verdicts in via `_fixup_failed`).
+        Accounting happens at settle, never dispatch, so retried or
+        contained chunks cannot double-count."""
+        if all_ok is None:
+            lanes_ok = ok[:count]
+            if needs is not None:
+                lanes_ok = lanes_ok | needs[:count]
+            all_ok = bool(np.all(lanes_ok))
         self._verdict_acc = self._verdict_acc and bool(all_ok)
-        self._dispatched += n
-        return per_lane, needs
+        self._dispatched += count
+
+    def _note_host_lanes(self, results: np.ndarray) -> None:
+        self._verdict_acc = self._verdict_acc and bool(np.all(results))
+        self._dispatched += len(results)
 
     def verify_checks_with_verdict(self, checks: Sequence[SigCheck]):
         """(per-check results, block-level all-ok).

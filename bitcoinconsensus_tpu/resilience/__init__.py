@@ -1,0 +1,68 @@
+"""Fault containment and graceful degradation for the dispatch path.
+
+The reference verifier is fail-closed by construction: a single-threaded
+pure function whose every anomaly is a REJECT (`src/lib.rs:103-139`,
+SURVEY §1). Our device path has failure modes the reference never had —
+a corrupted kernel verdict buffer, a dispatch exception out of the XLA
+runtime, a dropped mesh device, a poisoned cache entry — and the
+north-star ("heavy traffic from millions of users") demands those faults
+cost *latency*, never *correctness*, and never take the pipeline down.
+
+Three pieces, composed around `crypto/jax_backend.TpuSecpVerifier`'s
+dispatch/settle seam:
+
+- ``faults`` — a deterministic, seed-driven fault-injection harness.
+  Injection points are registered in `crypto/jax_backend.py` (dispatch
+  exceptions, verdict corruption), `parallel/mesh.py` (device drop),
+  `models/batch.py` (driver-level dispatch failure) and
+  `models/sigcache.py` (poisoned hits). With no injector armed every
+  hook is one module-global read — chaos machinery costs nothing in
+  production.
+- ``guards`` — verdict validation on every device return: shape, dtype
+  domain ({0,1}), finiteness, plus per-dispatch *sentinel lanes* —
+  known-answer checks written into the pad region of each packed batch
+  whose verdicts are recomputed against precomputed expectations. Any
+  anomaly raises ``VerdictAnomaly`` and the affected lanes demote to the
+  exact host oracle (`TpuSecpVerifier._host_check` /
+  `nat_verify_inputs_idx` MODE_EXACT).
+- ``degrade`` — the degradation ladder: bounded retry with a wall-clock
+  deadline around dispatch, backend quarantine
+  (mesh/Pallas → XLA → host-exact) after repeated failures, and
+  automatic count-based re-promotion probes.
+
+Containment floor (documented, not hidden): the sentinel design catches
+systematic verdict corruption — whole-buffer inversion/garbage, encoding
+faults, dead kernels — and the domain guards catch anything non-boolean.
+A single flipped lane *inside the real-lane region only* is below the
+sentinel detection floor, exactly as a single DRAM bitflip is below a
+checksum's; `scripts/consensus_chaos.py` sweeps the catchable classes
+and asserts bit-identical results against the host-exact oracle.
+
+Everything here is host-side policy, never consensus: no module in this
+package is imported by traced kernel code, and timing flows through the
+sanctioned ``obs`` clock (`analysis/host_lint.py` lints this package
+with the clock rule).
+"""
+
+from .degrade import DispatchResilience, Ladder
+from .faults import FaultPlan, FaultSpec, InjectedFault, InjectedTimeout, inject
+from .guards import (
+    VerdictAnomaly,
+    install_sentinels,
+    set_cache_audit,
+    validate_verdict,
+)
+
+__all__ = [
+    "DispatchResilience",
+    "FaultPlan",
+    "FaultSpec",
+    "InjectedFault",
+    "InjectedTimeout",
+    "Ladder",
+    "VerdictAnomaly",
+    "inject",
+    "install_sentinels",
+    "set_cache_audit",
+    "validate_verdict",
+]

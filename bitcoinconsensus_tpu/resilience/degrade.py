@@ -1,0 +1,170 @@
+"""Degradation ladder: bounded retry, backend quarantine, re-promotion.
+
+The dispatch path has an ordered ladder of backends, fastest first and
+each strictly more trustworthy than the last:
+
+    TpuSecpVerifier:      pallas -> xla -> host
+    ShardedSecpVerifier:  mesh   -> xla -> host
+
+A dispatch that keeps failing (exceptions out of the runtime, verdict
+buffers the guards reject) *quarantines* its level: the ladder demotes
+one rung after ``demote_after`` consecutive failures, and the bottom
+rung — the host-exact oracle, the same code the reference semantics are
+pinned to — cannot fail this way, so the pipeline always terminates with
+correct verdicts. Faults cost latency, never correctness, never a crash.
+
+Quarantine is not forever: after ``probe_after`` consecutive successful
+settles at the demoted level, the next dispatch *probes* the level above;
+a successful probe re-promotes, a failed one re-arms the count. Probes
+are count-based, not time-based, so the whole state machine is
+deterministic and unit-testable without sleeping.
+
+Retry policy (`DispatchResilience`): a failed dispatch retries at most
+``max_retries`` times within a ``retry_deadline_s`` wall-clock budget
+(read through the sanctioned ``obs.monotonic`` clock — this module is
+linted with the same clock rule as `crypto/`). Deadline exhaustion is a
+failure like any other: the ladder demotes and the work lands on host.
+
+State is per-verifier-instance and mutated only from the verifier's
+driver thread (the same discipline as its `_seen_shapes`).
+"""
+
+from __future__ import annotations
+
+from typing import Sequence, Tuple
+
+from ..obs import counter as _obs_counter
+from ..obs import gauge as _obs_gauge
+from ..obs import monotonic as _monotonic
+
+__all__ = ["DispatchFailed", "DispatchResilience", "HOST_LEVEL", "Ladder"]
+
+# The ladder's terminal rung: dispatch layers compare against this marker
+# and route straight to their host-exact oracle when quarantined this far.
+HOST_LEVEL = "host"
+
+_LEVEL = _obs_gauge(
+    "consensus_resilience_level",
+    "current ladder rung (0 = fastest backend, rising = quarantined)",
+    ("ladder",),
+)
+_DEMOTIONS = _obs_counter(
+    "consensus_resilience_demotions_total",
+    "ladder demotions after repeated dispatch failures",
+    ("ladder", "src", "dst"),
+)
+_REPROMOTIONS = _obs_counter(
+    "consensus_resilience_repromotions_total",
+    "ladder re-promotions after a successful probe",
+    ("ladder", "src", "dst"),
+)
+_PROBES = _obs_counter(
+    "consensus_resilience_probes_total",
+    "re-promotion probe dispatches at a quarantined level",
+    ("ladder", "level"),
+)
+_RETRIES = _obs_counter(
+    "consensus_resilience_retries_total",
+    "dispatch retries after a contained fault",
+    ("site",),
+)
+
+
+class DispatchFailed(RuntimeError):
+    """Every device rung failed within the retry budget (host takes over)."""
+
+
+class Ladder:
+    """Quarantine state machine over an ordered backend list."""
+
+    def __init__(
+        self,
+        levels: Sequence[str],
+        name: str,
+        demote_after: int = 2,
+        probe_after: int = 16,
+    ):
+        if not levels or levels[-1] != HOST_LEVEL:
+            raise ValueError("a ladder must end at the host rung")
+        self.levels: Tuple[str, ...] = tuple(levels)
+        self.name = name
+        self.demote_after = demote_after
+        self.probe_after = probe_after
+        self._idx = 0
+        self._fail_streak = 0
+        self._ok_streak = 0  # successes at the current (quarantined) rung
+        _LEVEL.set(0, ladder=name)
+
+    @property
+    def current(self) -> str:
+        return self.levels[self._idx]
+
+    def pick_level(self) -> Tuple[str, bool]:
+        """Level for the next dispatch, and whether it is a probe.
+
+        While quarantined, every ``probe_after``-th consecutive success
+        earns one dispatch at the rung above; its outcome (reported via
+        `report`) decides re-promotion.
+        """
+        if self._idx > 0 and self._ok_streak >= self.probe_after:
+            lvl = self.levels[self._idx - 1]
+            _PROBES.inc(ladder=self.name, level=lvl)
+            return lvl, True
+        return self.current, False
+
+    def report(self, level: str, ok: bool, probe: bool = False) -> None:
+        """Record a settled dispatch outcome for `level`."""
+        if probe:
+            self._ok_streak = 0  # one probe per earned window either way
+            if ok:
+                src, self._idx = self.current, self.levels.index(level)
+                _REPROMOTIONS.inc(ladder=self.name, src=src, dst=level)
+                _LEVEL.set(self._idx, ladder=self.name)
+                self._fail_streak = 0
+            return
+        if ok:
+            self._fail_streak = 0
+            if self._idx > 0:
+                self._ok_streak += 1
+            return
+        self._fail_streak += 1
+        self._ok_streak = 0
+        if (
+            self._fail_streak >= self.demote_after
+            and self._idx < len(self.levels) - 1
+        ):
+            src = self.current
+            self._idx += 1
+            self._fail_streak = 0
+            _DEMOTIONS.inc(ladder=self.name, src=src, dst=self.current)
+            _LEVEL.set(self._idx, ladder=self.name)
+
+
+class DispatchResilience:
+    """Retry budget + ladder for one verifier instance."""
+
+    def __init__(
+        self,
+        levels: Sequence[str],
+        name: str,
+        demote_after: int = 2,
+        probe_after: int = 16,
+        max_retries: int = 3,
+        retry_deadline_s: float = 2.0,
+    ):
+        self.ladder = Ladder(
+            levels, name, demote_after=demote_after, probe_after=probe_after
+        )
+        self.max_retries = max_retries
+        self.retry_deadline_s = retry_deadline_s
+
+    def deadline(self) -> float:
+        """Absolute retry deadline for a dispatch starting now."""
+        return _monotonic() + self.retry_deadline_s
+
+    def may_retry(self, attempts: int, deadline: float, site: str) -> bool:
+        """True (and counted) if another attempt fits the retry budget."""
+        if attempts > self.max_retries or _monotonic() >= deadline:
+            return False
+        _RETRIES.inc(site=site)
+        return True

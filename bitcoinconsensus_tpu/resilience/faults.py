@@ -1,0 +1,217 @@
+"""Deterministic, seed-driven fault injection for the dispatch path.
+
+Chaos discipline (the `CCheckQueue` analogue of Jepsen-style fault
+drills): every fault the containment layer claims to survive is
+*injectable on demand*, reproducibly, so `scripts/consensus_chaos.py`
+and CI can assert the claim instead of trusting it.
+
+Design constraints:
+
+- **Deterministic.** An injector is a (plan, seed) pair; the same pair
+  fires the same faults at the same sites in the same order, and lane
+  selection for verdict corruption comes from a seeded PRNG. A chaos
+  failure in CI replays locally from its seed.
+- **Bounded.** Every `FaultSpec` carries a `count`; once drained the
+  site goes quiet, so retry/degradation logic can be tested both in the
+  "transient fault, retry succeeds" and the "persistent fault, quarantine
+  + host fallback" regimes by choosing `count`.
+- **Free when idle.** Every hook's fast path is one module-global read
+  (`_active is None`); production traffic pays nothing for the harness
+  being linked in.
+
+Sites registered by the pipeline (grep for the literal):
+
+    jax_backend.dispatch    raise/timeout at device dispatch
+    jax_backend.verdict     corrupt the materialized verdict buffer
+    mesh.dispatch           raise at sharded dispatch (device drop)
+    batch.dispatch          raise at the batch driver's resolve step
+    sigcache.sig            poisoned hit on the signature cache
+
+This module is host-side policy, never consensus; it is linted with the
+clock rule only (`analysis/host_lint.py`) and reads no clocks at all.
+"""
+
+from __future__ import annotations
+
+import random
+from contextlib import contextmanager
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ..obs import counter as _obs_counter
+
+__all__ = [
+    "FaultPlan",
+    "FaultSpec",
+    "FaultInjector",
+    "InjectedFault",
+    "InjectedTimeout",
+    "active",
+    "corrupt_verdict",
+    "inject",
+    "maybe_raise",
+    "poison_hit",
+]
+
+_FAULTS_FIRED = _obs_counter(
+    "consensus_resilience_faults_injected_total",
+    "chaos-harness faults fired, by site and kind",
+    ("site", "kind"),
+)
+
+# Corruption kinds vs raise kinds: `corrupt_verdict` consumes the former,
+# `maybe_raise` the latter, so one plan can arm both on one site.
+_RAISE_KINDS = ("raise", "timeout")
+_CORRUPT_KINDS = ("invert", "flip", "value", "nan", "garbage", "shape")
+
+
+class InjectedFault(RuntimeError):
+    """A fault fired by the chaos harness (site/kind in the message)."""
+
+    def __init__(self, site: str, kind: str):
+        super().__init__(f"injected {kind} fault at {site}")
+        self.site = site
+        self.kind = kind
+
+
+class InjectedTimeout(InjectedFault):
+    """Injected dispatch timeout (distinct type: deadline-path tests)."""
+
+
+@dataclass
+class FaultSpec:
+    """One armed fault: fire `kind` at `site` up to `count` times.
+
+    kind: "raise" | "timeout"             -> maybe_raise sites
+          "invert"                        -> logical NOT of the whole buffer
+          "flip"                          -> flip `lanes` PRNG-chosen lanes
+          "value"                         -> set `lanes` lanes to `value`
+                                             (int32 cast: non-{0,1} verdict)
+          "nan"                           -> set `lanes` lanes to NaN
+                                             (float32 cast)
+          "garbage"                       -> whole buffer PRNG int32 noise
+          "shape"                         -> truncate the buffer by one lane
+          "poison"                        -> poison_hit sites report a hit
+    """
+
+    site: str
+    kind: str
+    count: int = 1
+    lanes: int = 1
+    value: int = 7
+
+
+class FaultPlan:
+    """An ordered set of FaultSpecs; `inject(plan, seed)` arms it."""
+
+    def __init__(self, specs: Sequence[FaultSpec]):
+        self.specs = list(specs)
+
+    def __iter__(self):
+        return iter(self.specs)
+
+
+class FaultInjector:
+    """Armed plan + seeded PRNG; tracks per-spec remaining fire counts."""
+
+    def __init__(self, plan: FaultPlan, seed: int = 0):
+        self._rng = random.Random(seed)
+        self._remaining: List[List] = [[spec, spec.count] for spec in plan]
+        self.fired: Dict[tuple, int] = {}
+
+    def _take(self, site: str, kinds) -> Optional[FaultSpec]:
+        for ent in self._remaining:
+            spec, left = ent
+            if left > 0 and spec.site == site and spec.kind in kinds:
+                ent[1] = left - 1
+                key = (site, spec.kind)
+                self.fired[key] = self.fired.get(key, 0) + 1
+                _FAULTS_FIRED.inc(site=site, kind=spec.kind)
+                return spec
+        return None
+
+    def total_fired(self) -> int:
+        return sum(self.fired.values())
+
+
+_active: Optional[FaultInjector] = None
+
+
+def active() -> Optional[FaultInjector]:
+    return _active
+
+
+@contextmanager
+def inject(plan: FaultPlan, seed: int = 0):
+    """Arm `plan` for the dynamic extent of the block (not reentrant —
+    chaos runs are single-plan by design)."""
+    global _active
+    if _active is not None:
+        raise RuntimeError("a fault plan is already armed")
+    inj = FaultInjector(plan, seed=seed)
+    _active = inj
+    try:
+        yield inj
+    finally:
+        _active = None
+
+
+def maybe_raise(site: str) -> None:
+    """Dispatch-site hook: raises when a raise/timeout fault is armed."""
+    inj = _active
+    if inj is None:
+        return
+    spec = inj._take(site, _RAISE_KINDS)
+    if spec is None:
+        return
+    if spec.kind == "timeout":
+        raise InjectedTimeout(site, spec.kind)
+    raise InjectedFault(site, spec.kind)
+
+
+def poison_hit(site: str) -> bool:
+    """Cache-probe hook: True forces a fabricated hit (poisoned entry)."""
+    inj = _active
+    if inj is None:
+        return False
+    return inj._take(site, ("poison",)) is not None
+
+
+def corrupt_verdict(site: str, arr: np.ndarray) -> np.ndarray:
+    """Verdict-buffer hook: returns a corrupted COPY when armed, else the
+    array untouched. Corruption happens before the guards see the buffer,
+    so every injected class must be caught (or the chaos gate fails)."""
+    inj = _active
+    if inj is None:
+        return arr
+    spec = inj._take(site, _CORRUPT_KINDS)
+    if spec is None:
+        return arr
+    rng = inj._rng
+    if spec.kind == "invert":
+        return ~np.asarray(arr, dtype=bool)
+    if spec.kind == "shape":
+        return np.asarray(arr)[:-1]
+    if spec.kind == "garbage":
+        return np.asarray(
+            [rng.randrange(-(2**31), 2**31) for _ in range(len(arr))],
+            dtype=np.int32,
+        )
+    out = np.array(arr)  # writable copy, original dtype
+    idxs = [rng.randrange(len(out)) for _ in range(min(spec.lanes, len(out)))]
+    if spec.kind == "flip":
+        for i in idxs:
+            out[i] = not bool(out[i])
+        return out
+    if spec.kind == "value":
+        out = out.astype(np.int32)
+        for i in idxs:
+            out[i] = spec.value
+        return out
+    # nan
+    out = out.astype(np.float32)
+    for i in idxs:
+        out[i] = np.nan
+    return out

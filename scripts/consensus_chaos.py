@@ -1,0 +1,385 @@
+#!/usr/bin/env python
+"""Chaos sweep: inject every catchable fault class, assert containment.
+
+The executable form of the fail-closed contract (README "Robustness"):
+every fault class the resilience layer claims to contain is injected —
+deterministically, from `--seed` — against a mini workload, and the
+verdicts must come back **bit-identical to the host-exact oracle**. A
+corrupted ACCEPT anywhere fails the sweep; faults may cost latency
+(retries, ladder demotions, host re-verification), never correctness.
+
+Swept classes (see resilience/faults.py for the site registry):
+
+    verdict corruption   invert / value / nan / garbage / shape at
+                         `jax_backend.verdict` (transient, and a
+                         persistent run that quarantines to host)
+    dispatch failure     raise / timeout at `jax_backend.dispatch`
+    device drop          raise at `mesh.dispatch` (sharded verifier)
+    driver failure       raise at `batch.dispatch` (verify_batch)
+    cache poisoning      fabricated hit at `sigcache.sig`, caught by
+                         audit mode (`resilience.set_cache_audit`)
+
+Single-lane flips inside the real-lane region are *below the documented
+detection floor* (package docstring) and are deliberately not part of
+the containment contract, so they are not swept here.
+
+`--check` additionally enforces the overhead budget: with no injector
+armed, the resilience hooks (fault-site reads, verdict validation,
+sentinel install/check, ladder bookkeeping) must cost < 1% of a small
+`verify_batch` — measured by timing the hooks themselves during an
+instrumented run, the same accounting style as
+tests/test_obs.py::test_no_sink_overhead_under_one_percent.
+
+Usage:
+    python scripts/consensus_chaos.py                     # sweep, JSON out
+    python scripts/consensus_chaos.py --seed 3            # replay a seed
+    python scripts/consensus_chaos.py --seed 0 --check    # CI gate
+    python scripts/consensus_chaos.py --report chaos.json # write report
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# Mesh trial wants >1 device; must be set before jax initializes. 8
+# matches tests/conftest.py so the suite's persistent XLA compile cache
+# is shared (device count is part of the cache key).
+if "XLA_FLAGS" not in os.environ:
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+
+import numpy as np  # noqa: E402
+
+
+def _mixed_checks(n):
+    """n valid mixed-kind SigChecks + one cryptographically-false ECDSA
+    check appended (wrong message), so every trial proves both that no
+    REJECT is corrupted into an ACCEPT and vice versa."""
+    import hashlib
+
+    import __graft_entry__ as ge
+    from bitcoinconsensus_tpu.crypto import secp_host as H
+    from bitcoinconsensus_tpu.crypto.jax_backend import SigCheck
+
+    checks = ge._example_checks(n)
+    sk = 0xC0FFEE
+    msg = hashlib.sha256(b"chaos-signed").digest()
+    wrong = hashlib.sha256(b"chaos-presented").digest()
+    checks.append(
+        SigCheck("ecdsa", (H.pubkey_create(sk), H.sign_ecdsa(sk, msg), wrong))
+    )
+    return checks
+
+
+def _host_oracle(verifier, checks):
+    return np.asarray([verifier._host_check(c) for c in checks], dtype=bool)
+
+
+def _verifier_trial(name, checks, oracle, specs, seed):
+    """Fresh single-device verifier, one armed plan, oracle comparison."""
+    from bitcoinconsensus_tpu.crypto.jax_backend import TpuSecpVerifier
+    from bitcoinconsensus_tpu.resilience import FaultPlan, inject
+
+    v = TpuSecpVerifier(min_batch=8)
+    with inject(FaultPlan(specs), seed=seed) as inj:
+        out = np.asarray(v.verify_checks(checks), dtype=bool)
+    return {
+        "trial": name,
+        "fired": {f"{s}:{k}": c for (s, k), c in sorted(inj.fired.items())},
+        "fault_fired": inj.total_fired() >= 1,
+        "bit_identical": bool(np.array_equal(out, oracle)),
+        "ladder_end": v._resilience.ladder.current,
+    }
+
+
+def _mesh_trial(checks, oracle, seed):
+    """Sharded verifier with a device-drop fault at dispatch."""
+    from bitcoinconsensus_tpu.parallel.mesh import (
+        ShardedSecpVerifier,
+        make_mesh,
+    )
+    from bitcoinconsensus_tpu.resilience import FaultPlan, FaultSpec, inject
+
+    sv = ShardedSecpVerifier(mesh=make_mesh())
+    plan = FaultPlan([FaultSpec("mesh.dispatch", "raise")])
+    with inject(plan, seed=seed) as inj:
+        res, verdict = sv.verify_checks_with_verdict(checks)
+    out = np.asarray(res, dtype=bool)
+    return {
+        "trial": "mesh-device-drop",
+        "fired": {f"{s}:{k}": c for (s, k), c in sorted(inj.fired.items())},
+        "fault_fired": inj.total_fired() >= 1,
+        "bit_identical": bool(np.array_equal(out, oracle)),
+        "verdict_correct": verdict == bool(oracle.all()),
+        "ladder_end": sv._resilience.ladder.current,
+    }
+
+
+def _batch_items(funded, bad_first=False):
+    """One single-input BatchItem per funded output; `bad_first` corrupts
+    the first item's signature (well-formed, cryptographically false)."""
+    from bitcoinconsensus_tpu.core.flags import VERIFY_ALL_EXTENDED
+    from bitcoinconsensus_tpu.models.batch import BatchItem
+    from bitcoinconsensus_tpu.utils import blockgen
+
+    items = []
+    for j, f in enumerate(funded):
+        corrupt = 0 if (bad_first and j == 0) else None
+        tx = blockgen.build_spend_tx([f], corrupt_input=corrupt)
+        items.append(
+            BatchItem(
+                tx.serialize(), 0, VERIFY_ALL_EXTENDED,
+                spent_outputs=[(f.amount, f.wallet.spk)],
+            )
+        )
+    return items
+
+
+def _fresh_caches():
+    from bitcoinconsensus_tpu.models.sigcache import (
+        ScriptExecutionCache,
+        SigCache,
+    )
+
+    return SigCache(), ScriptExecutionCache()
+
+
+def _batch_trial(items, oracle, seed):
+    """verify_batch with a driver-level dispatch fault."""
+    from bitcoinconsensus_tpu.models.batch import verify_batch
+    from bitcoinconsensus_tpu.resilience import FaultPlan, FaultSpec, inject
+
+    sig_cache, script_cache = _fresh_caches()
+    plan = FaultPlan([FaultSpec("batch.dispatch", "raise")])
+    with inject(plan, seed=seed) as inj:
+        res = verify_batch(items, sig_cache=sig_cache, script_cache=script_cache)
+    got = [r.ok for r in res]
+    return {
+        "trial": "batch-dispatch-raise",
+        "fired": {f"{s}:{k}": c for (s, k), c in sorted(inj.fired.items())},
+        "fault_fired": inj.total_fired() >= 1,
+        "bit_identical": got == oracle,
+    }
+
+
+def _poison_trial(warm_items, probe_items, probe_oracle, seed):
+    """Poisoned sig-cache hit under audit mode.
+
+    Pass 1 populates the caches; pass 2 probes fresh keys — the first
+    belonging to a cryptographically-false signature — with a `poison`
+    fault armed, so the fabricated hit would be a corrupted ACCEPT if
+    audit mode failed to catch and evict it.
+    """
+    from bitcoinconsensus_tpu.models.batch import verify_batch
+    from bitcoinconsensus_tpu.resilience import (
+        FaultPlan,
+        FaultSpec,
+        inject,
+        set_cache_audit,
+    )
+    from bitcoinconsensus_tpu.resilience.guards import CACHE_POISON_CAUGHT
+
+    sig_cache, script_cache = _fresh_caches()
+    verify_batch(warm_items, sig_cache=sig_cache, script_cache=script_cache)
+    caught0 = CACHE_POISON_CAUGHT.value(cache="sig")
+    plan = FaultPlan([FaultSpec("sigcache.sig", "poison")])
+    set_cache_audit(True)
+    try:
+        with inject(plan, seed=seed) as inj:
+            res = verify_batch(
+                probe_items, sig_cache=sig_cache, script_cache=script_cache
+            )
+    finally:
+        set_cache_audit(False)
+    got = [r.ok for r in res]
+    return {
+        "trial": "sigcache-poison-audit",
+        "fired": {f"{s}:{k}": c for (s, k), c in sorted(inj.fired.items())},
+        "fault_fired": inj.total_fired() >= 1,
+        "bit_identical": got == probe_oracle,
+        "poison_caught": int(CACHE_POISON_CAUGHT.value(cache="sig") - caught0),
+    }
+
+
+def _overhead_budget(items):
+    """Resilience cost with no injector armed, as a fraction of a warm
+    `verify_batch` wall time. Times the hooks themselves (wrapper
+    clocks around every resilience entry point) rather than an A/B
+    wall-clock diff, which would be noise at this scale."""
+    from bitcoinconsensus_tpu.models.batch import verify_batch
+    from bitcoinconsensus_tpu.resilience import degrade as D
+    from bitcoinconsensus_tpu.resilience import faults as F
+    from bitcoinconsensus_tpu.resilience import guards as G
+
+    def run():
+        sig_cache, script_cache = _fresh_caches()
+        verify_batch(items, sig_cache=sig_cache, script_cache=script_cache)
+
+    run()  # warm jit/compile caches; timing below excludes compiles
+    wall = min(_timed(run) for _ in range(3))
+
+    targets = [
+        (F, "maybe_raise"), (F, "poison_hit"), (F, "active"),
+        (F, "corrupt_verdict"),
+        (G, "validate_verdict"), (G, "install_sentinels"),
+        (G, "check_sentinels"), (G, "audit_cache_hits"),
+        (D.Ladder, "pick_level"), (D.Ladder, "report"),
+        (D.DispatchResilience, "deadline"),
+        (D.DispatchResilience, "may_retry"),
+    ]
+    spent = {f"{o.__name__}.{n}": 0.0 for o, n in targets}
+    calls = {f"{o.__name__}.{n}": 0 for o, n in targets}
+    saved = [(o, n, getattr(o, n)) for o, n in targets]
+
+    def _timing(key, fn):
+        def wrapper(*a, **kw):
+            t0 = time.perf_counter()
+            try:
+                return fn(*a, **kw)
+            finally:
+                spent[key] += time.perf_counter() - t0
+                calls[key] += 1
+        return wrapper
+
+    try:
+        for o, n, fn in saved:
+            setattr(o, n, _timing(f"{o.__name__}.{n}", fn))
+        run()
+    finally:
+        for o, n, fn in saved:
+            setattr(o, n, fn)
+
+    total = sum(spent.values())
+    return {
+        "wall_s": wall,
+        "resilience_s": total,
+        "ratio": total / wall,
+        "hook_calls": {k: v for k, v in sorted(calls.items()) if v},
+        "budget_ok": total < 0.01 * wall,
+    }
+
+
+def _timed(fn):
+    t0 = time.perf_counter()
+    fn()
+    return time.perf_counter() - t0
+
+
+def run_sweep(seed: int) -> dict:
+    from bitcoinconsensus_tpu.crypto.jax_backend import TpuSecpVerifier
+    from bitcoinconsensus_tpu.models.batch import verify_batch
+    from bitcoinconsensus_tpu.resilience import FaultSpec
+    from bitcoinconsensus_tpu.utils import blockgen
+
+    checks = _mixed_checks(13)  # 14 lanes -> padded 16, pad room for sentinels
+    oracle_v = _host_oracle(TpuSecpVerifier(min_batch=8), checks)
+    trials = []
+
+    # Clean baseline: the guarded dispatch path itself must be exact.
+    trials.append(_verifier_trial("clean", checks, oracle_v, [], seed))
+
+    # Transient verdict corruption + dispatch failures: one fault, the
+    # retry path absorbs it without quarantining.
+    for kind in ("invert", "value", "nan", "garbage", "shape"):
+        trials.append(_verifier_trial(
+            f"verdict-{kind}", checks, oracle_v,
+            [FaultSpec("jax_backend.verdict", kind)], seed,
+        ))
+    for kind in ("raise", "timeout"):
+        trials.append(_verifier_trial(
+            f"dispatch-{kind}", checks, oracle_v,
+            [FaultSpec("jax_backend.dispatch", kind)], seed,
+        ))
+
+    # Persistent corruption: every retry fails, the ladder must walk all
+    # the way down and finish on the host-exact rung.
+    persistent = _verifier_trial(
+        "verdict-garbage-persistent", checks, oracle_v,
+        [FaultSpec("jax_backend.verdict", "garbage", count=64)], seed,
+    )
+    persistent["quarantined_to_host"] = persistent["ladder_end"] == "host"
+    trials.append(persistent)
+
+    trials.append(_mesh_trial(checks, oracle_v, seed))
+
+    # Batch-driver trials share one funded view, split across passes.
+    _view, funded = blockgen.make_funded_view(8, seed="chaos")
+    warm_items = _batch_items(funded[:4])
+    probe_items = _batch_items(funded[4:], bad_first=True)
+    sig_cache, script_cache = _fresh_caches()
+    oracle_b = [
+        r.ok for r in verify_batch(
+            warm_items, sig_cache=sig_cache, script_cache=script_cache)
+    ]
+    sig_cache, script_cache = _fresh_caches()
+    oracle_p = [
+        r.ok for r in verify_batch(
+            probe_items, sig_cache=sig_cache, script_cache=script_cache)
+    ]
+    assert not oracle_p[0] and all(oracle_p[1:]), oracle_p
+    trials.append(_batch_trial(warm_items, oracle_b, seed))
+    trials.append(_poison_trial(warm_items, probe_items, oracle_p, seed))
+
+    overhead = _overhead_budget(warm_items)
+    return {"seed": seed, "trials": trials, "overhead": overhead}
+
+
+def _problems(report: dict) -> list:
+    probs = []
+    for t in report["trials"]:
+        if not t["bit_identical"]:
+            probs.append(f"{t['trial']}: verdicts differ from host oracle")
+        if t["trial"] != "clean" and not t["fault_fired"]:
+            probs.append(f"{t['trial']}: armed fault never fired (dead site?)")
+        for key in ("verdict_correct", "quarantined_to_host"):
+            if t.get(key) is False:
+                probs.append(f"{t['trial']}: {key} is False")
+    ov = report["overhead"]
+    if not ov["budget_ok"]:
+        probs.append(
+            f"resilience overhead {ov['resilience_s'] * 1e6:.0f}us is "
+            f">= 1% of verify_batch wall {ov['wall_s'] * 1e3:.2f}ms"
+        )
+    return probs
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--seed", type=int, default=0,
+                    help="fault-injection seed (default: 0)")
+    ap.add_argument("--check", action="store_true",
+                    help="exit 1 unless every fault class is contained "
+                    "bit-identically and the overhead budget holds")
+    ap.add_argument("--report", metavar="PATH",
+                    help="write the JSON report to this path")
+    args = ap.parse_args(argv)
+
+    report = run_sweep(args.seed)
+    doc = json.dumps(report, indent=2)
+    if args.report:
+        with open(args.report, "w", encoding="utf-8") as fh:
+            fh.write(doc + "\n")
+    print(doc)
+
+    probs = _problems(report)
+    contained = sum(1 for t in report["trials"] if t["bit_identical"])
+    print(
+        f"# {contained}/{len(report['trials'])} trials bit-identical, "
+        f"overhead ratio {report['overhead']['ratio']:.4%}, "
+        f"{len(probs)} problems",
+        file=sys.stderr,
+    )
+    if args.check and probs:
+        for p in probs:
+            print(f"PROBLEM: {p}", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

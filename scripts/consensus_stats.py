@@ -71,6 +71,11 @@ REQUIRED_METRICS = [
     # block connect
     "consensus_blocks_total",
     "consensus_block_reject_total",
+    # resilience (clean-path samples: ladder gauge set at verifier
+    # construction, sentinel lanes ride every padded dispatch; the fault
+    # counters only light up under scripts/consensus_chaos.py)
+    "consensus_resilience_level",
+    "consensus_resilience_sentinel_lanes_total",
     # spans
     "consensus_span_duration_seconds",
 ]

@@ -373,3 +373,77 @@ def test_adversarial_multisig_oracle_work_is_bounded():
             assert (got.error, got.script_error) == (want_err, want_serr)
     assert res[0].ok and res[1].ok and not res[2].ok and res[3].ok
     assert len(dispatches) <= 2, f"oracle work unbounded: {dispatches}"
+
+
+def test_fixpoint_round_cap_exact_fallback():
+    """`run_idx_fixpoint` round cap: inputs that never reach an exact
+    verdict fall to the host-exact oracle bit-identically, counted in
+    `consensus_exact_fallback_total`. Driven with a stub session whose
+    interpreter reports one unresolved oracle miss forever (the pathology
+    the cap exists for: a cursor that never converges)."""
+    import numpy as np
+
+    from bitcoinconsensus_tpu.models.batch import (
+        _EXACT_FALLBACK,
+        run_idx_fixpoint,
+    )
+
+    class _StuckSession:
+        def uniq_count(self):
+            return 0  # no uniq growth: _resolve_uniq is a no-op
+
+    calls = {"rounds": 0, "fallback": []}
+    live = [3, 5, 8, 13]
+
+    def run_idx(pos):
+        calls["rounds"] += 1
+        n = len(pos)
+        return (
+            np.ones(n, dtype=bool),        # optimistic ok
+            np.zeros(n, dtype=np.int32),   # err
+            np.ones(n, dtype=np.int32),    # unk: one miss each, forever
+            np.zeros(0, dtype=np.int32),   # rec_idx: nothing recorded
+            np.zeros(1, dtype=np.int64),   # bounds
+        )
+
+    def exact_fallback(idx):
+        calls["fallback"].append(idx)
+        return (idx % 2 == 1, 0 if idx % 2 else 39)
+
+    before = _EXACT_FALLBACK.value()
+    final = run_idx_fixpoint(
+        _StuckSession(), None, None, live, run_idx, exact_fallback,
+        max_rounds=3,
+    )
+    assert calls["rounds"] == 3  # the cap really bounded the loop
+    assert sorted(calls["fallback"]) == live
+    assert final == {idx: (idx % 2 == 1, 0 if idx % 2 else 39) for idx in live}
+    assert _EXACT_FALLBACK.value() == before + len(live)
+
+
+def test_batch_all_script_cache_hits():
+    """Replay edge: a batch whose every item hits the script-execution
+    cache resolves without interpretation or dispatch, bit-identical to
+    the first pass (the mempool->block skip, validation.cpp:1529-1536)."""
+    from bitcoinconsensus_tpu.models.sigcache import (
+        ScriptExecutionCache,
+        SigCache,
+    )
+
+    items = []
+    for seed in ("allhit-1", "allhit-2", "allhit-3"):
+        txb, spk, amt = make_p2wpkh_spend(seed)
+        items.append(
+            BatchItem(txb, 0, VERIFY_ALL_LIBCONSENSUS,
+                      spent_output_script=spk, amount=amt)
+        )
+    sig_cache = SigCache(cache_label="allhit-sig")
+    script_cache = ScriptExecutionCache(cache_label="allhit-script")
+    first = verify_batch(items, sig_cache=sig_cache,
+                         script_cache=script_cache)
+    assert [r.ok for r in first] == [True] * 3
+    hits0 = script_cache.hits
+    second = verify_batch(items, sig_cache=sig_cache,
+                          script_cache=script_cache)
+    assert [r.ok for r in second] == [True] * 3
+    assert script_cache.hits == hits0 + len(items)  # every item a hit

@@ -174,17 +174,25 @@ def test_span_aggregates_into_registry():
 
 
 def test_broken_sink_never_breaks_a_span():
+    """A dying sink must not take down a verify — and must not vanish
+    silently either: every dropped record lands in
+    `consensus_obs_sink_errors_total` (resilience triage contract)."""
+
     class Broken:
         def write(self, record):
             raise OSError("disk full")
 
+    before = S._SINK_ERRORS.value(sink="Broken")
     b = Broken()
     add_sink(b)
     try:
         with span("obs-test-broken-sink"):
             pass  # must not raise
+        with span("obs-test-broken-sink-2"):
+            pass
     finally:
         remove_sink(b)
+    assert S._SINK_ERRORS.value(sink="Broken") == before + 2
 
 
 def test_jsonl_sink_roundtrip():
