@@ -32,6 +32,25 @@ from typing import FrozenSet, Iterator, List, Sequence
 # Rule groups, selectable per scanned tree.
 ALL_RULES = frozenset({"float", "nondeterminism", "time"})
 TIMING_RULES = frozenset({"time"})
+# Async-dispatch discipline: on the device-dispatch path, forcing an
+# in-flight JAX array to host (`np.asarray`, `.block_until_ready()`,
+# `jax.device_get`) is a hidden synchronization point that silently
+# serializes the pipeline — and bypasses the settle seam's guards. The
+# ONLY sanctioned block points are the settle seam itself and
+# `resilience/inflight.settle_array` (SYNC_ALLOWED_FUNCS).
+SYNC_RULES = frozenset({"sync"})
+# Function bodies allowed to materialize device buffers.
+SYNC_ALLOWED_FUNCS = {
+    "_materialize_guarded",  # crypto/jax_backend.py — the settle seam
+    "settle_array",          # resilience/inflight.py — sanctioned helper
+    "make_mesh",             # parallel/mesh.py — host device-list shaping
+}
+# module.attr calls that force a device→host sync.
+SYNC_BANNED_CALLS = {
+    ("np", "asarray"), ("numpy", "asarray"),
+    ("np", "array"), ("numpy", "array"),
+    ("jax", "device_get"),
+}
 # Pallas kernel-body discipline: inside `_kernel_body`, every limb
 # constant must come through the consts_ref row table installed by
 # `_kernel`'s set_const_provider — materializing an ndarray there makes
@@ -137,6 +156,25 @@ class _Visitor(ast.NodeVisitor):
                     "array constant — route limb constants through the "
                     "consts_ref row table (limbs.set_const_provider), the "
                     "one audited constant path into VMEM")
+        if "sync" in self.rules and not any(
+            n in SYNC_ALLOWED_FUNCS for n in self._fn_stack
+        ):
+            if isinstance(fn, ast.Attribute):
+                if fn.attr == "block_until_ready":
+                    self._flag(
+                        node, "sync",
+                        ".block_until_ready() outside the settle seam — "
+                        "in-flight buffers settle through "
+                        "resilience/inflight (settle_array or "
+                        "_materialize_guarded), never ad-hoc blocking")
+                elif (isinstance(fn.value, ast.Name)
+                      and (fn.value.id, fn.attr) in SYNC_BANNED_CALLS):
+                    self._flag(
+                        node, "sync",
+                        f"{fn.value.id}.{fn.attr}() on the dispatch path "
+                        "forces a hidden device→host sync — route "
+                        "materialization through inflight.settle_array "
+                        "or the settle seam")
         if (
             "time" in self.rules
             and isinstance(fn, ast.Attribute)
@@ -210,4 +248,12 @@ def lint_consensus_host(repo_root: str) -> List[LintFinding]:
                           rules=TIMING_RULES)
     findings += lint_paths([os.path.join(pkg, "ops", "pallas_kernel.py")],
                            rules=PALLAS_RULES)
+    # Async-dispatch discipline over the in-flight pipeline: the dispatch
+    # drivers and the queue itself must not force device buffers to host
+    # outside the settle seam (see SYNC_ALLOWED_FUNCS).
+    findings += lint_paths(
+        [os.path.join(pkg, "crypto", "jax_backend.py"),
+         os.path.join(pkg, "parallel", "mesh.py"),
+         os.path.join(pkg, "resilience", "inflight.py")],
+        rules=SYNC_RULES)
     return findings

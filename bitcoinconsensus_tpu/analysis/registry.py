@@ -175,6 +175,16 @@ def _specs() -> List[KernelSpec]:
             note="the full device-side verify batch (~70k eqns)",
         ),
         KernelSpec(
+            "jax_backend.verdict_checksum",
+            lambda B: (_verdict_checksum_fn(), (_bools(B),)),
+            in_bounds={0: (0, 1)},
+            # count sum <= B; weighted sum <= B * (max lane weight 251)
+            out_within=[[(0, DEFAULT_BATCH)], [(0, DEFAULT_BATCH * 251)]],
+            note="in-flight verdict checksum: any single-lane flip moves "
+                 "the count sum, any count-preserving swap moves the "
+                 "weighted sum (settle seam recomputes both on host)",
+        ),
+        KernelSpec(
             "pallas.verify_tiles",
             lambda B: _pallas_verify_build(),
             # Flag contract single-sourced from ops/pallas_kernel.py
@@ -197,6 +207,11 @@ def _specs() -> List[KernelSpec]:
 def _verify_kernel_fn():
     from ..crypto import jax_backend as JB
     return JB._verify_kernel
+
+
+def _verdict_checksum_fn():
+    from ..crypto import jax_backend as JB
+    return JB._verdict_checksum
 
 
 # verify_tiles requires B % LANE_TILE == 0 and a multi-step grid is the

@@ -79,6 +79,7 @@ from .secp_host import N, parse_der_lax
 from ..resilience import degrade as _degrade
 from ..resilience import faults as _faults
 from ..resilience import guards as _guards
+from ..resilience import inflight as _inflight
 
 __all__ = ["SigCheck", "TpuSecpVerifier", "default_verifier"]
 
@@ -366,6 +367,27 @@ def _verify_kernel(fields, want_odd, parity_req, has_t2, neg1, neg2, valid):
     return valid & ~inf & ok_x & par_ok
 
 
+def _verdict_checksum(ok):
+    """Device-side verdict checksum: (count, position-weighted) int32 sums.
+
+    Chained onto the still-async ok buffer as a *separate* tiny jitted
+    program, so the proven verify kernels are untouched; the settle seam
+    recomputes both sums host-side from the materialized buffer and any
+    mismatch (a single-lane flip anywhere, a replayed buffer) demotes the
+    ticket to the host oracle. Weights are i % 251 + 1, keeping the
+    weighted sum < 252·B — int32-safe to ~8.5M lanes (registered with the
+    interval prover as `jax_backend.verdict_checksum`).
+    """
+    v = ok.astype(jnp.int32)
+    w = jnp.arange(v.shape[0], dtype=jnp.int32) % jnp.int32(
+        _guards.CHECKSUM_MOD
+    ) + jnp.int32(1)
+    return jnp.sum(v), jnp.sum(v * w)
+
+
+_checksum_jit = jax.jit(_verdict_checksum)
+
+
 class TpuSecpVerifier:
     """Batched verifier; pads to power-of-two batch shapes and jits once per
     shape (persistent XLA cache across processes). Large batches are split
@@ -444,17 +466,55 @@ class TpuSecpVerifier:
             self._ladder_levels(), name=type(self).__name__
         )
         self._dispatch_level: Optional[str] = None
+        # In-flight settlement queue (resilience/inflight.py): dispatch
+        # returns tickets, settlement applies the guards/retry/ladder
+        # policy. Depth bounds unsettled host state (backpressure);
+        # deadline bounds how long a wedged ticket may retry before the
+        # host oracle takes the lanes. The device-side verdict checksum
+        # rides every dispatch unless explicitly disabled.
+        self._checksum = os.environ.get(
+            "BITCOINCONSENSUS_TPU_CHECKSUM", ""
+        ) not in ("0", "off")
+        self._inflight = _inflight.InflightQueue(
+            self._resilience,
+            self._SITE,
+            launch=self._launch_ticket,
+            materialize=self._materialize_guarded,
+            prepare=self._prepare_ticket,
+            on_device=self._on_device_settle,
+            max_depth=int(os.environ.get(
+                "BITCOINCONSENSUS_TPU_INFLIGHT_DEPTH", "4")),
+            deadline_s=float(os.environ.get(
+                "BITCOINCONSENSUS_TPU_SETTLE_DEADLINE_S", "8.0")),
+        )
+
+    @property
+    def _resilience(self) -> _degrade.DispatchResilience:
+        return self._resilience_obj
+
+    @_resilience.setter
+    def _resilience(self, value: _degrade.DispatchResilience) -> None:
+        # Keep the in-flight queue on the same policy object: tests (and
+        # operators) swap the resilience budget/ladder wholesale.
+        self._resilience_obj = value
+        queue = getattr(self, "_inflight", None)
+        if queue is not None:
+            queue._res = value
 
     def _pad(self, n: int) -> int:
+        # `n + 1`, not `n`: every padded shape reserves at least one pad
+        # lane for the rotating known-answer sentinel (containment floor).
+        # Chunked drivers slice at `lane_capacity` (= chunk - 1) so full
+        # chunks still land on the same power-of-two shape.
         size = self._min_batch
-        while size < n:
+        while size < n + 1:
             size *= 2
         if self._pad_step is not None:
             # Whichever is smaller: the power-of-two ladder or the step
             # rounding — a 5.6k main dispatch pads to 6144 (not 8192) while
             # a 4-check oracle round still pads to min_batch, not a full step.
             step = self._pad_step
-            return min(size, max(self._min_batch, ((n + step - 1) // step) * step))
+            return min(size, max(self._min_batch, ((n + step) // step) * step))
         return size
 
     def _prep_lanes(self, checks: Sequence[SigCheck]) -> List["_Lane"]:
@@ -492,7 +552,7 @@ class TpuSecpVerifier:
                     for _, r, px, m in schnorr_pending
                 ]
             )
-            digests = np.asarray(
+            digests = _inflight.settle_array(
                 bip340_challenge(stack[:, :32], stack[:, 32:64], stack[:, 64:])
             )
             for (lane, *_), d in zip(schnorr_pending, digests, strict=True):
@@ -508,21 +568,29 @@ class TpuSecpVerifier:
         sync cost is paid once, at the end. Cycle collection is paused
         for the duration (utils/gcpause.py — full GC passes over the JAX
         heap otherwise dominate the host-side cost of large batches).
+        Stream drivers split the two halves themselves
+        (`verify_checks_begin` / `verify_checks_finish`) so host prep for
+        batch N+1 overlaps batch N's wire time.
         """
         if not checks:
             return np.zeros(0, dtype=bool)
+        with gc_paused():
+            return self.verify_checks_finish(self.verify_checks_begin(checks))
+
+    def verify_checks_begin(self, checks: Sequence[SigCheck]):
+        """Async half of `verify_checks`: prep, pack and dispatch every
+        chunk through the in-flight queue; returns a pending handle
+        without synchronizing anything. The queue's bounded depth settles
+        the oldest ticket first if a caller races too far ahead."""
         kinds: dict = {}
         for c in checks:
             kinds[c.kind] = kinds.get(c.kind, 0) + 1
         for k, cnt in kinds.items():
             _CHECKS_TOTAL.inc(cnt, kind=k)
-        with gc_paused():
-            return self._verify_checks_impl(checks)
-
-    def _verify_checks_impl(self, checks: Sequence[SigCheck]) -> np.ndarray:
-        pending = []  # (dispatch record, start, count)
-        for start in range(0, len(checks), self._chunk):
-            sub_checks = checks[start : start + self._chunk]
+        pending = []  # (ticket, start, count)
+        cap = self.lane_capacity
+        for start in range(0, len(checks), cap):
+            sub_checks = checks[start : start + cap]
             if self._native is not None:
                 with self.phases("host_prep"):
                     args = self._native.prep_pack(
@@ -538,10 +606,16 @@ class TpuSecpVerifier:
                     (self._dispatch_guarded(args, len(sub_checks)), start,
                      len(sub_checks))
                 )
+        return (checks, pending)
+
+    def verify_checks_finish(self, handle) -> np.ndarray:
+        """Settle a `verify_checks_begin` handle: every ticket resolves
+        through the guards (or the host oracle) into the result array."""
+        checks, pending = handle
         out = np.zeros(len(checks), dtype=bool)
         with self.phases("sync"):
-            for rec, start, count in pending:
-                self._settle_guarded(rec, checks, out, start, count)
+            for ticket, start, count in pending:
+                self._settle_guarded(ticket, checks, out, start, count)
         return out
 
     # --- fault containment (resilience/) --------------------------------
@@ -567,30 +641,44 @@ class TpuSecpVerifier:
         finally:
             self._dispatch_level = None
 
-    def _dispatch_guarded(self, args: Tuple, n: int) -> dict:
-        """Async-dispatch one packed chunk at the ladder's current rung."""
-        level, probe = self._resilience.ladder.pick_level()
-        rec = {
-            "args": args, "n": n, "level": level, "probe": probe,
-            "attempts": 1, "deadline": self._resilience.deadline(),
-            "sset": _guards.install_sentinels(args, n),
-            "result": None, "error": None,
-        }
-        if level == _degrade.HOST_LEVEL:
-            return rec
-        try:
-            rec["result"] = self._run_level(args, n, level)
-        except Exception as e:  # containment boundary: work lands on host
-            rec["error"] = e
-        return rec
+    def _prepare_ticket(self, args: Tuple, n: int):
+        """Dispatch-time prep (inflight queue callback): copy read-only
+        native buffers, then seed the rotating known-answer lanes into
+        the reserved pad region — every dispatch carries sentinels."""
+        args, _copied = _guards.ensure_writable(args)
+        return args, _guards.install_sentinels(args, n)
 
-    def _materialize_guarded(self, rec: dict):
-        """Materialize + validate one dispatch record. Returns (ok, needs,
-        all_ok) — padded bool arrays and the sharded step's replicated
-        verdict scalar (None off-mesh). Raises VerdictAnomaly on a buffer
-        the guards reject."""
-        result = rec["result"]
-        padded = int(rec["args"][0].shape[0])
+    def _launch_ticket(self, args: Tuple, n: int, level: str):
+        """Launch one chunk at `level` (inflight queue callback); chains
+        the device-side verdict checksum onto the still-async ok buffer.
+        Returns (result, aux) with nothing synchronized."""
+        result = self._run_level(args, n, level)
+        aux = None
+        if self._checksum:
+            aux = _checksum_jit(result[0] if isinstance(result, tuple)
+                                else result)
+        return result, aux
+
+    def _on_device_settle(self, ticket, ok, needs, all_ok) -> None:
+        """Success hook (inflight queue callback): exactly once per
+        cleanly settled ticket, so subclass verdict accounting can never
+        double-count across retries."""
+        self._note_device_verdict(all_ok, ok, needs, ticket.n)
+
+    def _dispatch_guarded(self, args: Tuple, n: int) -> _inflight.Ticket:
+        """Async-dispatch one packed chunk; returns its in-flight ticket
+        (unsynchronized device arrays + settle context + deadline)."""
+        return self._inflight.dispatch(args, n)
+
+    def _materialize_guarded(self, ticket: _inflight.Ticket):
+        """The settle seam — the ONE place in-flight verdict buffers
+        become host memory. Materialize + validate one ticket: structural
+        guards, sentinel recheck, device-vs-host checksum compare.
+        Returns (ok, needs, all_ok) — padded bool arrays and the sharded
+        step's replicated verdict scalar (None off-mesh). Raises
+        VerdictAnomaly on a buffer the guards reject."""
+        result = ticket.result
+        padded = int(ticket.args[0].shape[0])
         all_ok = None
         needs_raw = None
         if isinstance(result, tuple):
@@ -607,54 +695,30 @@ class TpuSecpVerifier:
             needs = _guards.validate_verdict(
                 np.asarray(needs_raw), padded, self._SITE
             )
-        _guards.check_sentinels(rec["sset"], ok, needs, self._SITE)
+        _guards.check_sentinels(ticket.sset, ok, needs, self._SITE)
+        if ticket.aux is not None:
+            # Device sums were computed over the pristine in-flight
+            # buffer; recomputing from the materialized (possibly
+            # corrupted-in-transit) copy catches any single-lane flip —
+            # real-lane region included.
+            dev_sums = (int(np.asarray(ticket.aux[0])),
+                        int(np.asarray(ticket.aux[1])))
+            _guards.check_checksum(dev_sums, ok, self._SITE)
         if all_ok is not None:
             all_ok = bool(np.asarray(all_ok))
         return ok, needs, all_ok
 
-    def _settle_device(self, rec: dict, count: int):
-        """Retry/degradation loop for one dispatched record: validate, on
-        any fault report the rung and retry within the budget (walking the
-        ladder as it demotes). Returns (ok, needs) padded arrays that
-        passed every guard, or None when the chunk must resolve on the
+    def _settle_device(self, ticket: _inflight.Ticket, count: int):
+        """Settle one ticket through the in-flight queue's retry/
+        degradation policy. Returns (ok, needs) padded arrays that passed
+        every guard, or None when the chunk must resolve on the
         host-exact oracle (fail-closed terminal)."""
-        res = self._resilience
-        while rec["level"] != _degrade.HOST_LEVEL:
-            err = rec["error"]
-            if err is None:
-                try:
-                    ok, needs, all_ok = self._materialize_guarded(rec)
-                except Exception as e:  # VerdictAnomaly or runtime fault
-                    err = e
-                else:
-                    res.ladder.report(rec["level"], True, probe=rec["probe"])
-                    self._note_device_verdict(all_ok, ok, needs, count)
-                    return ok, needs
-            res.ladder.report(rec["level"], False, probe=rec["probe"])
-            if not res.may_retry(rec["attempts"], rec["deadline"], self._SITE):
-                break
-            rec["attempts"] += 1
-            rec["level"], rec["probe"] = res.ladder.pick_level()
-            if rec["level"] == _degrade.HOST_LEVEL:
-                break
-            rec["error"] = None
-            try:
-                rec["result"] = self._run_level(
-                    rec["args"], rec["n"], rec["level"]
-                )
-            except Exception as e:
-                rec["error"] = e
-        _guards.CONTAINED.inc(site=self._SITE)
-        _guards.HOST_EXACT_LANES.inc(count)
-        if res.ladder.current == _degrade.HOST_LEVEL:
-            # Settling on the bottom rung counts toward the re-promotion
-            # probe window (host itself cannot fail).
-            res.ladder.report(_degrade.HOST_LEVEL, True)
-        return None
+        return self._inflight.settle(ticket)
 
-    def _settle_guarded(self, rec: dict, checks: Sequence[SigCheck],
-                        out: np.ndarray, start: int, count: int) -> None:
-        settled = self._settle_device(rec, count)
+    def _settle_guarded(self, ticket: _inflight.Ticket,
+                        checks: Sequence[SigCheck], out: np.ndarray,
+                        start: int, count: int) -> None:
+        settled = self._settle_device(ticket, count)
         if settled is None:
             host_res = np.fromiter(
                 (self._host_check(checks[start + i]) for i in range(count)),
@@ -696,6 +760,13 @@ class TpuSecpVerifier:
     @property
     def chunk(self) -> int:
         return self._chunk
+
+    @property
+    def lane_capacity(self) -> int:
+        """Real lanes per chunk dispatch: one short of `chunk`, so the
+        reserved known-answer lane never pushes a full chunk up a pad
+        rung (8191 real lanes + 1 sentinel pad to 8192, not 16384)."""
+        return self._chunk - 1
 
     def dispatch_lanes(self, args: Tuple, n: int):
         """Async-dispatch one packed lane batch (the prep_pack 7-tuple,

@@ -62,7 +62,7 @@ from .sigcache import (
     default_sig_cache,
 )
 
-__all__ = ["BatchItem", "BatchResult", "verify_batch"]
+__all__ = ["BatchItem", "BatchResult", "verify_batch", "verify_batch_stream"]
 
 # Batch-driver telemetry (README "Observability"). All updates are host
 # side and integer-valued — this module is under the host AST lint, which
@@ -395,14 +395,14 @@ def _accept_mask(state: _UniqState, rec_idx: np.ndarray, bounds,
     return out
 
 
-def _resolve_uniq(nsess, verifier, sig_cache, state: _UniqState) -> None:
-    """Resolve uniq entries for one fixpoint round: salted sig-cache probe
-    first (success-only skip, script/sigcache.cpp:22-122), then packed
-    kernel lanes prepped IN the session (no check bytes cross the bridge)
-    and one pipelined device dispatch per chunk; exceptional lanes flagged
-    by the fast device adds resolve exactly via
-    nat_session_uniq_host_verify. Newly-known verdicts are published into
-    the native oracle.
+def _dispatch_uniq(nsess, verifier, sig_cache, state: _UniqState):
+    """Async half of the uniq resolve round: salted sig-cache probe first
+    (success-only skip, script/sigcache.cpp:22-122), then packed kernel
+    lanes prepped IN the session (no check bytes cross the bridge) and
+    one in-flight device dispatch per chunk. Returns an opaque round
+    record for `_settle_uniq` — nothing is synchronized here, so the
+    caller can run host work (the NEXT batch's interpretation) while the
+    lanes are on the wire. Returns None when no new uniq entries exist.
 
     Dispatch policy note: every unresolved entry resolves each round —
     INCLUDING the speculative CHECKMULTISIG pairings no rec_idx
@@ -416,7 +416,7 @@ def _resolve_uniq(nsess, verifier, sig_cache, state: _UniqState) -> None:
     U = nsess.uniq_count()
     lo = len(state.val)
     if U == lo:
-        return
+        return None
     _UNIQ_CHECKS.inc(U - lo)
     grow = np.arange(lo, U, dtype=np.int32)
     with verifier.phases("host_prep"):
@@ -443,30 +443,136 @@ def _resolve_uniq(nsess, verifier, sig_cache, state: _UniqState) -> None:
                     state.val[i] = True
             else:
                 miss.append(int(i))
+    pending = []
     if miss:
-        chunk = verifier.chunk
-        pending = []
-        for s in range(0, len(miss), chunk):
-            sub = np.asarray(miss[s : s + chunk], dtype=np.int32)
+        cap = verifier.lane_capacity
+        for s in range(0, len(miss), cap):
+            sub = np.asarray(miss[s : s + cap], dtype=np.int32)
             with verifier.phases("host_prep"):
                 lanes = nsess.uniq_lanes(sub, verifier.pad(len(sub)))
             pending.append((verifier.dispatch_lanes(lanes, len(sub)), sub))
-        for pend, sub in pending:
-            okv, needs = verifier.sync_lanes(pend, len(sub))
-            okv = np.array(okv, dtype=bool, copy=True)
-            if needs is not None and needs.any():
-                fix = np.nonzero(needs)[0]
-                _HOST_FIXUPS.inc(len(fix))
-                for t in fix:
-                    r = nsess.uniq_host_verify(int(sub[t]))
-                    okv[t] = r
-                    if not r:
-                        verifier._fixup_failed = True
-            state.val[sub] = okv
-            for t in np.nonzero(okv)[0]:  # success-only, like the reference
-                sig_cache.add_key(keys[int(sub[int(t)])])
+    return grow, keys, pending
+
+
+def _settle_uniq(nsess, verifier, sig_cache, state: _UniqState,
+                 round_rec) -> None:
+    """Settle half of the uniq resolve round: every in-flight ticket
+    resolves through the verifier's guards (exceptional or contained
+    lanes land on nat_session_uniq_host_verify), verdicts publish into
+    the native oracle and successes into the salted sig cache."""
+    if round_rec is None:
+        return
+    grow, keys, pending = round_rec
+    for pend, sub in pending:
+        okv, needs = verifier.sync_lanes(pend, len(sub))
+        okv = np.array(okv, dtype=bool, copy=True)
+        if needs is not None and needs.any():
+            fix = np.nonzero(needs)[0]
+            _HOST_FIXUPS.inc(len(fix))
+            for t in fix:
+                r = nsess.uniq_host_verify(int(sub[t]))
+                okv[t] = r
+                if not r:
+                    verifier._fixup_failed = True
+        state.val[sub] = okv
+        for t in np.nonzero(okv)[0]:  # success-only, like the reference
+            sig_cache.add_key(keys[int(sub[int(t)])])
 
     nsess.publish_uniq(grow, state.val[grow].astype(np.int32))
+
+
+def _resolve_uniq(nsess, verifier, sig_cache, state: _UniqState) -> None:
+    """One synchronous uniq resolve round (dispatch + settle back-to-back)."""
+    _settle_uniq(nsess, verifier, sig_cache, state,
+                 _dispatch_uniq(nsess, verifier, sig_cache, state))
+
+
+class IdxFixpoint:
+    """The deferral fixpoint both index-mode drivers share
+    (`_verify_batch_idx` and models/validate.py `_connect_block_native` —
+    ONE copy of the consensus-critical loop), split into an async `begin`
+    and a settling `finish` so stream drivers can overlap batches.
+
+    `begin()` interprets the pending inputs (`run_idx(pos) -> (ok, err,
+    unk, rec_idx, bounds)`) and dispatches every newly-discovered uniq
+    check, leaving the round's device lanes IN FLIGHT. `finish()` settles
+    them, accepts inputs whose verdicts are exact (no misses, or every
+    optimistic guess confirmed true), and runs any remaining rounds to
+    the fixpoint; inputs still pending at the round cap go through
+    `exact_fallback(idx) -> (ok, err_code)`. A stream driver calls batch
+    N+1's `begin()` between batch N's `begin()` and `finish()`, so host
+    interpretation runs while the previous batch is on the wire —
+    `verify_batch_stream` is that driver."""
+
+    def __init__(
+        self,
+        nsess,
+        verifier: TpuSecpVerifier,
+        sig_cache: SigCache,
+        live: Sequence[int],
+        run_idx,
+        exact_fallback,
+        max_rounds: int = 24,  # > MAX_PUBKEYS_PER_MULTISIG cursor retries
+    ):
+        self.nsess = nsess
+        self.verifier = verifier
+        self.sig_cache = sig_cache
+        self.run_idx = run_idx
+        self.exact_fallback = exact_fallback
+        self.max_rounds = max_rounds
+        self.final: Dict[int, Tuple[bool, int]] = {}
+        self._state = _UniqState()
+        self._pending = list(live)
+        self._rounds = 0
+        self._in_flight = None  # (interp tuple, uniq round record)
+
+    def begin(self) -> None:
+        """Start one round: interpret + dispatch, nothing synchronized."""
+        if self._in_flight is not None or not self._pending:
+            return
+        if self._rounds >= self.max_rounds:
+            return
+        self._rounds += 1
+        with _span("batch.interpret", n=len(self._pending)):
+            interp = self.run_idx(self._pending)
+        with _span("batch.resolve"):
+            rec = _dispatch_uniq(self.nsess, self.verifier, self.sig_cache,
+                                 self._state)
+        self._in_flight = (interp, rec)
+
+    def _settle_round(self) -> None:
+        interp, rec = self._in_flight
+        self._in_flight = None
+        with _span("batch.resolve"):
+            _settle_uniq(self.nsess, self.verifier, self.sig_cache,
+                         self._state, rec)
+        ok, err, unk, rec_idx, bounds = interp
+        # exact verdict (unk == 0), or optimistic with every guess
+        # confirmed true — equivalent to an exact pass
+        accept = _accept_mask(self._state, rec_idx, bounds, unk)
+        still: List[int] = []
+        for k, idx in enumerate(self._pending):
+            if accept[k]:
+                self.final[idx] = (bool(ok[k]), int(err[k]))
+            else:
+                still.append(idx)
+        self._pending = still
+
+    def finish(self) -> Dict[int, Tuple[bool, int]]:
+        """Settle the in-flight round, then loop to the fixpoint."""
+        if self._in_flight is not None:
+            self._settle_round()
+        while self._pending and self._rounds < self.max_rounds:
+            self.begin()
+            if self._in_flight is None:  # defensive: begin refused
+                break
+            self._settle_round()
+        _FIXPOINT_ROUNDS.observe(self._rounds)
+        if self._pending:  # round cap hit: exact host fallback
+            _EXACT_FALLBACK.inc(len(self._pending))
+        for idx in self._pending:
+            self.final[idx] = self.exact_fallback(idx)
+        return self.final
 
 
 def run_idx_fixpoint(
@@ -476,44 +582,14 @@ def run_idx_fixpoint(
     live: Sequence[int],
     run_idx,
     exact_fallback,
-    max_rounds: int = 24,  # > MAX_PUBKEYS_PER_MULTISIG cursor retries
+    max_rounds: int = 24,
 ) -> Dict[int, Tuple[bool, int]]:
-    """The deferral fixpoint both index-mode drivers share (`_verify_batch_idx`
-    and models/validate.py `_connect_block_native` — ONE copy of the
-    consensus-critical loop): interpret pending inputs (`run_idx(pos) ->
-    (ok, err, unk, rec_idx, bounds)`), resolve every newly-discovered uniq
-    check (cache probe + device dispatch + publish), accept inputs whose
-    verdicts are exact (no misses, or every miss confirmed true), repeat;
-    inputs still pending at the round cap go through `exact_fallback(idx)
-    -> (ok, err_code)`. Returns {input: (ok, script_err_code)}."""
-    final: Dict[int, Tuple[bool, int]] = {}
-    state = _UniqState()
-    pending = list(live)
-    rounds = 0
-    for _round in range(max_rounds):
-        if not pending:
-            break
-        rounds += 1
-        with _span("batch.interpret", n=len(pending)):
-            ok, err, unk, rec_idx, bounds = run_idx(pending)
-        with _span("batch.resolve"):
-            _resolve_uniq(nsess, verifier, sig_cache, state)
-        # exact verdict (unk == 0), or optimistic with every guess
-        # confirmed true — equivalent to an exact pass
-        accept = _accept_mask(state, rec_idx, bounds, unk)
-        still: List[int] = []
-        for k, idx in enumerate(pending):
-            if accept[k]:
-                final[idx] = (bool(ok[k]), int(err[k]))
-            else:
-                still.append(idx)
-        pending = still
-    _FIXPOINT_ROUNDS.observe(rounds)
-    if pending:  # round cap hit: exact host fallback
-        _EXACT_FALLBACK.inc(len(pending))
-    for idx in pending:
-        final[idx] = exact_fallback(idx)
-    return final
+    """Synchronous fixpoint (begin + finish back-to-back); the signature
+    models/validate.py `_connect_block_native` drives."""
+    run = IdxFixpoint(nsess, verifier, sig_cache, live, run_idx,
+                      exact_fallback, max_rounds=max_rounds)
+    run.begin()
+    return run.finish()
 
 
 def _verify_batch_idx(
@@ -535,34 +611,58 @@ def _verify_batch_idx(
     uniq trio). Interpretation shards across `_idx_threads()` workers
     (checkqueue.h:29-163 shape). Results are bit-identical to the wire
     driver and the per-input API (tests/test_batch.py runs both paths)."""
-    live = [i for i, p in enumerate(preps) if p.result is None]
+    run = _idx_fixpoint_for(items, preps, nsess, verifier, sig_cache)
     final: Dict[int, Tuple[bool, int]] = {}
-    if live:
-        n_threads = _idx_threads()
+    if run is not None:
+        run.begin()
+        final = run.finish()
+    return _assemble_idx_results(preps, final, script_cache, script_keys)
 
-        def run_idx(pos: List[int]):
-            with verifier.phases("interpret"):
-                return nsess.verify_inputs_idx(
-                    [preps[i].ntx for i in pos],
-                    [items[i].input_index for i in pos],
-                    [preps[i].amount for i in pos],
-                    [preps[i].script_pubkey for i in pos],
-                    [items[i].flags for i in pos],
-                    n_threads=n_threads,
-                )
 
-        def exact_fallback(idx: int) -> Tuple[bool, int]:
-            okx, err_code, _ = nsess.verify_input(
-                preps[idx].ntx, items[idx].input_index, preps[idx].amount,
-                preps[idx].script_pubkey, items[idx].flags,
-                mode=native_bridge.NativeSession.MODE_EXACT,
+def _idx_fixpoint_for(
+    items: Sequence[BatchItem],
+    preps: List[_Prepared],
+    nsess,
+    verifier: TpuSecpVerifier,
+    sig_cache: SigCache,
+) -> Optional[IdxFixpoint]:
+    """Build the fixpoint runner for a prepared index-mode batch (None
+    when every input already resolved via transport checks or the script
+    cache). Shared by the synchronous driver and the stream driver."""
+    live = [i for i, p in enumerate(preps) if p.result is None]
+    if not live:
+        return None
+    n_threads = _idx_threads()
+
+    def run_idx(pos: List[int]):
+        with verifier.phases("interpret"):
+            return nsess.verify_inputs_idx(
+                [preps[i].ntx for i in pos],
+                [items[i].input_index for i in pos],
+                [preps[i].amount for i in pos],
+                [preps[i].script_pubkey for i in pos],
+                [items[i].flags for i in pos],
+                n_threads=n_threads,
             )
-            return okx, err_code
 
-        final = run_idx_fixpoint(
-            nsess, verifier, sig_cache, live, run_idx, exact_fallback
+    def exact_fallback(idx: int) -> Tuple[bool, int]:
+        okx, err_code, _ = nsess.verify_input(
+            preps[idx].ntx, items[idx].input_index, preps[idx].amount,
+            preps[idx].script_pubkey, items[idx].flags,
+            mode=native_bridge.NativeSession.MODE_EXACT,
         )
+        return okx, err_code
 
+    return IdxFixpoint(nsess, verifier, sig_cache, live, run_idx,
+                       exact_fallback)
+
+
+def _assemble_idx_results(
+    preps: List[_Prepared],
+    final: Dict[int, Tuple[bool, int]],
+    script_cache: ScriptExecutionCache,
+    script_keys: List[Optional[bytes]],
+) -> List[BatchResult]:
     out: List[BatchResult] = []
     for idx, prep in enumerate(preps):
         if prep.result is not None:
@@ -605,19 +705,82 @@ def verify_batch(
     return out
 
 
-def _verify_batch_impl(
-    items: Sequence[BatchItem],
-    verifier: Optional[TpuSecpVerifier],
-    sig_cache: Optional[SigCache],
-    script_cache: Optional[ScriptExecutionCache],
-) -> List[BatchResult]:
+def verify_batch_stream(
+    batches,
+    verifier: Optional[TpuSecpVerifier] = None,
+    sig_cache: Optional[SigCache] = None,
+    script_cache: Optional[ScriptExecutionCache] = None,
+    depth: int = 2,
+):
+    """Pipelined `verify_batch` over an iterable of item lists.
+
+    Yields one result list per input batch, in order, bit-identical to
+    calling `verify_batch` per batch — but with up to `depth` batches in
+    flight: batch N+1's parse/probe/interpretation runs on the host while
+    batch N's device lanes are on the wire, so a sustained stream pays
+    the link latency once, not once per batch. The verifier's bounded
+    in-flight queue still applies per dispatch (backpressure), and every
+    ticket settles through the resilience guards — overlap never bypasses
+    containment.
+
+    Batches that cannot take the index-mode path (no native core, or a
+    transport-failed parse without a native handle) fall back to a
+    synchronous `verify_batch` for that batch; ordering is preserved.
+    """
     if verifier is None:
         verifier = default_verifier()
     if sig_cache is None:
         sig_cache = default_sig_cache()
     if script_cache is None:
         script_cache = default_script_cache()
+    depth = max(1, int(depth))
+    window: List[tuple] = []
 
+    def _begin(items):
+        with gc_paused(), _span("batch.stream_begin", n=len(items)):
+            if native_bridge.available() and _idx_mode_enabled():
+                nsess, preps, script_keys, _ = _prepare_and_probe(
+                    items, script_cache
+                )
+                if all(p.result is not None or p.ntx is not None
+                       for p in preps):
+                    _BATCH_SIZE.observe(len(items))
+                    _BATCH_ITEMS.inc(len(items))
+                    run = _idx_fixpoint_for(items, preps, nsess, verifier,
+                                            sig_cache)
+                    if run is not None:
+                        run.begin()
+                    return ("idx", run, preps, script_keys)
+        # Synchronous fallback: full verify (its own metrics/spans).
+        return ("done", verify_batch(items, verifier, sig_cache,
+                                     script_cache))
+
+    def _finish(handle):
+        if handle[0] == "done":
+            return handle[1]
+        _tag, run, preps, script_keys = handle
+        with gc_paused(), _span("batch.stream_finish", n=len(preps)):
+            final = run.finish() if run is not None else {}
+            out = _assemble_idx_results(preps, final, script_cache,
+                                        script_keys)
+        _record_batch_results(out)
+        return out
+
+    for items in batches:
+        window.append(_begin(items))
+        while len(window) >= depth:
+            yield _finish(window.pop(0))
+    while window:
+        yield _finish(window.pop(0))
+
+
+def _prepare_and_probe(
+    items: Sequence[BatchItem],
+    script_cache: ScriptExecutionCache,
+):
+    """Front half shared by the batch drivers: parse/prepare every item
+    (native session when available) and probe the script-execution cache.
+    Returns (nsess, preps, script_keys, use_native)."""
     use_native = native_bridge.available()
     nsess = native_bridge.NativeSession() if use_native else None
     tx_cache: Dict[bytes, Tuple[Tx, bool]] = {}
@@ -658,6 +821,29 @@ def _verify_batch_impl(
             script_keys[idx] = key
             if script_cache.contains_key(key):
                 preps[idx].result = BatchResult.success()
+    return nsess, preps, script_keys, use_native
+
+
+def _idx_mode_enabled() -> bool:
+    return os.environ.get("BITCOINCONSENSUS_TPU_IDX", "") not in ("0", "off")
+
+
+def _verify_batch_impl(
+    items: Sequence[BatchItem],
+    verifier: Optional[TpuSecpVerifier],
+    sig_cache: Optional[SigCache],
+    script_cache: Optional[ScriptExecutionCache],
+) -> List[BatchResult]:
+    if verifier is None:
+        verifier = default_verifier()
+    if sig_cache is None:
+        sig_cache = default_sig_cache()
+    if script_cache is None:
+        script_cache = default_script_cache()
+
+    nsess, preps, script_keys, use_native = _prepare_and_probe(
+        items, script_cache
+    )
 
     # Fast path: with the native core on, every prep either failed
     # transport checks (result set) or holds a native tx handle — the
@@ -667,7 +853,7 @@ def _verify_batch_impl(
     # the executable spec; tests run the corpus through both).
     if (
         use_native
-        and os.environ.get("BITCOINCONSENSUS_TPU_IDX", "") not in ("0", "off")
+        and _idx_mode_enabled()
         and all(p.result is not None or p.ntx is not None for p in preps)
     ):
         return _verify_batch_idx(

@@ -29,14 +29,25 @@ dispatch/settle seam:
   deadline around dispatch, backend quarantine
   (mesh/Pallas → XLA → host-exact) after repeated failures, and
   automatic count-based re-promotion probes.
+- ``inflight`` — the asynchronous settlement queue. ``_dispatch_guarded``
+  returns a *ticket* (unsynchronized device arrays + fault-site context
+  + wall-clock deadline) instead of blocking; host prep for batch N+1
+  runs while batch N is on the wire, and every ticket still settles
+  through the guards, the retry budget, and the ladder. Bounded queue
+  depth gives backpressure (a stalled device degrades gracefully), and a
+  ladder demotion re-dispatches still-queued tickets off the quarantined
+  backend. ``inflight.settle_array`` is the one sanctioned host
+  materialization point outside the settle seam (enforced by the
+  `host_lint` sync rule).
 
-Containment floor (documented, not hidden): the sentinel design catches
-systematic verdict corruption — whole-buffer inversion/garbage, encoding
-faults, dead kernels — and the domain guards catch anything non-boolean.
-A single flipped lane *inside the real-lane region only* is below the
-sentinel detection floor, exactly as a single DRAM bitflip is below a
-checksum's; `scripts/consensus_chaos.py` sweeps the catchable classes
-and asserts bit-identical results against the host-exact oracle.
+Containment floor (closed): the sentinel design catches systematic
+verdict corruption — whole-buffer inversion/garbage, encoding faults,
+dead kernels — the domain guards catch anything non-boolean, and the
+per-dispatch device-side verdict checksum (rotating known-answer lanes +
+(count, weighted) sums recomputed at settle) catches single-lane flips
+anywhere in the buffer, real-lane region included. `flip` is a hard pass
+criterion in `scripts/consensus_chaos.py`, which asserts bit-identical
+results against the host-exact oracle for every fault class.
 
 Everything here is host-side policy, never consensus: no module in this
 package is imported by traced kernel code, and timing flows through the
@@ -52,17 +63,21 @@ from .guards import (
     set_cache_audit,
     validate_verdict,
 )
+from .inflight import InflightQueue, Ticket, settle_array
 
 __all__ = [
     "DispatchResilience",
     "FaultPlan",
     "FaultSpec",
+    "InflightQueue",
     "InjectedFault",
     "InjectedTimeout",
     "Ladder",
+    "Ticket",
     "VerdictAnomaly",
     "inject",
     "install_sentinels",
     "set_cache_audit",
+    "settle_array",
     "validate_verdict",
 ]

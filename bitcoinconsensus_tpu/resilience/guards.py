@@ -19,11 +19,21 @@ consensus verdict it must survive:
    the runtime, or the readback corrupted the buffer *systematically*,
    and the whole chunk demotes to host.
 
-Containment floor (also in the package docstring): sentinels catch
-whole-buffer corruption classes (inversion, garbage, encoding faults,
-dead kernels) and structural validation catches anything non-boolean.
-A single flipped lane strictly inside the real-lane region is below this
-detection floor, as a single DRAM bitflip is below a checksum's.
+Containment floor (closed as of the in-flight dispatch PR): sentinels
+catch whole-buffer corruption classes (inversion, garbage, encoding
+faults, dead kernels), structural validation catches anything
+non-boolean, and the **verdict checksum** (`check_checksum`) closes the
+remaining gap: a device-side (count, position-weighted) sum over the
+verdict buffer, dispatched with the batch and compared at settle against
+the same sums recomputed from the materialized buffer. Any single-lane
+flip — sentinel region or real-lane region — changes the count by ±1
+and mismatches; `flip` is a hard pass criterion in the chaos sweep.
+Sentinel templates additionally *rotate* across dispatches
+(`install_sentinels`), so a replayed/stuck verdict buffer that answers
+the previous dispatch's pattern is caught; the dispatch layer pads every
+shape with at least one spare lane (`TpuSecpVerifier._pad`) and copies
+read-only native buffers (`ensure_writable`) so no dispatch goes out
+sentinel-less.
 
 Cache audit mode (`set_cache_audit`): when armed, the batch driver
 re-verifies cache hits against the host oracle and evicts proven-wrong
@@ -45,13 +55,17 @@ from ..crypto.glv import split_lambda
 from ..obs import counter as _obs_counter
 
 __all__ = [
+    "CHECKSUM_MOD",
     "SentinelSet",
     "VerdictAnomaly",
     "audit_cache_hits",
+    "check_checksum",
     "check_sentinels",
+    "ensure_writable",
     "install_sentinels",
     "set_cache_audit",
     "validate_verdict",
+    "verdict_checksum_host",
 ]
 
 GUARD_ANOMALIES = _obs_counter(
@@ -82,6 +96,11 @@ CACHE_POISON_CAUGHT = _obs_counter(
     "consensus_resilience_cache_poison_caught_total",
     "cache hits whose audit re-verification disagreed (entry evicted)",
     ("cache",),
+)
+_WRITABLE_COPIES = _obs_counter(
+    "consensus_resilience_writable_copies_total",
+    "packed batches copied host-side so sentinels could be installed "
+    "(native prep_pack hands back read-only views)",
 )
 
 
@@ -196,16 +215,44 @@ class SentinelSet:
             )
 
 
-def install_sentinels(args: Tuple, n: int) -> Optional[SentinelSet]:
+_rotation = 0
+
+
+def ensure_writable(args: Tuple) -> Tuple[Tuple, bool]:
+    """Return `(args, copied)` with every packed buffer host-writable.
+
+    The native bridge's ``prep_pack`` hands back read-only views over the
+    C-owned arena; sentinels must be written in place, so those batches
+    are copied once host-side (a memcpy of the packed lanes — counted in
+    ``consensus_resilience_writable_copies_total``). Already-writable
+    batches pass through untouched.
+    """
+    if all(getattr(a, "flags", None) is not None and a.flags.writeable
+           for a in args):
+        return args, False
+    _WRITABLE_COPIES.inc()
+    return tuple(np.array(a) for a in args), True
+
+
+def install_sentinels(
+    args: Tuple, n: int, rotation: Optional[int] = None
+) -> Optional[SentinelSet]:
     """Write sentinel lanes into the pad region of a packed batch, in place.
 
     `args` is the verifier's packed 7-tuple (fields, want_odd, parity,
     has_t2, neg1, neg2, valid); `n` is the real lane count, so rows
-    [n, size) are pad. Returns the SentinelSet to check at settle, or
-    None (counted) when the batch has no pad room or the buffers are not
-    writable (native prep_pack hands back read-only views — containment
-    there falls to structural validation alone).
+    [n, size) are pad. Templates rotate across dispatches (a process-wide
+    counter advances the starting template each call) so consecutive
+    batches of the same shape carry *different* expected patterns — a
+    stuck or replayed verdict buffer that answers the previous dispatch's
+    pattern mismatches. Pass `rotation` to pin the phase (tests).
+
+    Returns the SentinelSet to check at settle, or None (counted) when
+    the batch has no pad room or the buffers are not writable — callers
+    that must not dispatch sentinel-less copy first via
+    ``ensure_writable``.
     """
+    global _rotation
     fields, want_odd, parity, has_t2, neg1, neg2, valid = args
     size = int(fields.shape[0])
     room = size - n
@@ -218,10 +265,13 @@ def install_sentinels(args: Tuple, n: int) -> Optional[SentinelSet]:
         _SENTINEL_SKIPPED.inc(reason="readonly")
         return None
     templates = _sentinel_templates()
+    if rotation is None:
+        rotation = _rotation
+        _rotation = (_rotation + 1) % len(templates)
     k = min(room, len(templates))
     positions, expected = [], []
     for i in range(k):
-        raw, w, par, h2, n1, n2, exp = templates[i]
+        raw, w, par, h2, n1, n2, exp = templates[(rotation + i) % len(templates)]
         pos = n + i
         fields[pos] = np.frombuffer(raw, dtype=np.uint8).reshape(4, 32)
         want_odd[pos] = w
@@ -245,6 +295,49 @@ def check_sentinels(
     """Module-level convenience: no-op for sentinel-less dispatches."""
     if sset is not None:
         sset.check(ok, needs, site)
+
+
+# --- verdict checksum -------------------------------------------------------
+#
+# The single-flip detector. The dispatch layer chains a tiny jitted
+# reduction onto the in-flight verdict buffer: (sum of lanes, sum of
+# lane·weight) with weight[i] = i % CHECKSUM_MOD + 1. At settle the same
+# two sums are recomputed host-side from the materialized buffer and must
+# match exactly. Any single-lane flip changes the count sum by ±1; the
+# weighted sum localizes most multi-lane corruptions the count parity
+# would miss. int32-safe on device: 252 · B < 2^31 for B up to ~8.5M
+# lanes (the interval prover certifies the registered kernel).
+
+CHECKSUM_MOD = 251
+
+
+def verdict_checksum_host(ok: np.ndarray) -> Tuple[int, int]:
+    """Host recomputation of the device verdict checksum (int64 math)."""
+    v = np.asarray(ok).astype(np.int64)
+    w = np.arange(v.shape[0], dtype=np.int64) % CHECKSUM_MOD + 1
+    return int(v.sum()), int((v * w).sum())
+
+
+def check_checksum(
+    device_sums: Optional[Tuple[int, int]], ok: np.ndarray, site: str
+) -> None:
+    """Compare device-side verdict sums against the materialized buffer.
+
+    `device_sums` is the materialized (count, weighted) pair the dispatch
+    layer computed on-device over the same buffer; None means the
+    dispatch carried no checksum (counted as a guard skip is not needed —
+    the caller decides whether checksum-less dispatch is allowed). Raises
+    ``VerdictAnomaly(reason="checksum")`` on mismatch.
+    """
+    if device_sums is None:
+        return
+    count, wsum = verdict_checksum_host(ok)
+    dev = (int(device_sums[0]), int(device_sums[1]))
+    if dev != (count, wsum):
+        GUARD_ANOMALIES.inc(site=site, reason="checksum")
+        raise VerdictAnomaly(
+            site, "checksum", f"device {dev} vs host {(count, wsum)}"
+        )
 
 
 # --- cache audit mode -------------------------------------------------------

@@ -10,18 +10,23 @@ corrupted ACCEPT anywhere fails the sweep; faults may cost latency
 
 Swept classes (see resilience/faults.py for the site registry):
 
-    verdict corruption   invert / value / nan / garbage / shape at
-                         `jax_backend.verdict` (transient, and a
+    verdict corruption   invert / flip / value / nan / garbage / shape
+                         at `jax_backend.verdict` (transient, and a
                          persistent run that quarantines to host)
     dispatch failure     raise / timeout at `jax_backend.dispatch`
     device drop          raise at `mesh.dispatch` (sharded verifier)
     driver failure       raise at `batch.dispatch` (verify_batch)
     cache poisoning      fabricated hit at `sigcache.sig`, caught by
                          audit mode (`resilience.set_cache_audit`)
+    in-flight faults     the same verdict/dispatch classes injected
+                         while a second batch overlaps the first
+                         through `verify_checks_begin/finish` — the
+                         async pipeline must settle fail-closed too
 
-Single-lane flips inside the real-lane region are *below the documented
-detection floor* (package docstring) and are deliberately not part of
-the containment contract, so they are not swept here.
+Single-lane `flip` inside the real-lane region is a **hard pass
+criterion**: the device-side verdict checksum recomputed at the settle
+seam (resilience/guards.check_checksum) detects any single flip and any
+count-preserving swap, so the old detection-floor caveat is closed.
 
 `--check` additionally enforces the overhead budget: with no injector
 armed, the resilience hooks (fault-site reads, verdict validation,
@@ -93,6 +98,33 @@ def _verifier_trial(name, checks, oracle, specs, seed):
         "fired": {f"{s}:{k}": c for (s, k), c in sorted(inj.fired.items())},
         "fault_fired": inj.total_fired() >= 1,
         "bit_identical": bool(np.array_equal(out, oracle)),
+        "ladder_end": v._resilience.ladder.current,
+    }
+
+
+def _async_trial(name, checks, oracle, specs, seed):
+    """Faults injected while two batches overlap through begin/finish.
+
+    Batch B is dispatched while batch A is still in flight, so the fault
+    fires against an unsynchronized ticket; both must settle to verdicts
+    bit-identical to the host oracle.
+    """
+    from bitcoinconsensus_tpu.crypto.jax_backend import TpuSecpVerifier
+    from bitcoinconsensus_tpu.resilience import FaultPlan, inject
+
+    v = TpuSecpVerifier(min_batch=8)
+    with inject(FaultPlan(specs), seed=seed) as inj:
+        ha = v.verify_checks_begin(checks)
+        hb = v.verify_checks_begin(checks)
+        out_a = np.asarray(v.verify_checks_finish(ha), dtype=bool)
+        out_b = np.asarray(v.verify_checks_finish(hb), dtype=bool)
+    return {
+        "trial": name,
+        "fired": {f"{s}:{k}": c for (s, k), c in sorted(inj.fired.items())},
+        "fault_fired": inj.total_fired() >= 1,
+        "bit_identical": bool(
+            np.array_equal(out_a, oracle) and np.array_equal(out_b, oracle)
+        ),
         "ladder_end": v._resilience.ladder.current,
     }
 
@@ -285,7 +317,7 @@ def run_sweep(seed: int) -> dict:
 
     # Transient verdict corruption + dispatch failures: one fault, the
     # retry path absorbs it without quarantining.
-    for kind in ("invert", "value", "nan", "garbage", "shape"):
+    for kind in ("invert", "flip", "value", "nan", "garbage", "shape"):
         trials.append(_verifier_trial(
             f"verdict-{kind}", checks, oracle_v,
             [FaultSpec("jax_backend.verdict", kind)], seed,
@@ -295,6 +327,18 @@ def run_sweep(seed: int) -> dict:
             f"dispatch-{kind}", checks, oracle_v,
             [FaultSpec("jax_backend.dispatch", kind)], seed,
         ))
+
+    # In-flight leg: the same fault classes while a second batch
+    # overlaps the first through the async begin/finish seam.
+    for kind in ("flip", "garbage"):
+        trials.append(_async_trial(
+            f"async-verdict-{kind}", checks, oracle_v,
+            [FaultSpec("jax_backend.verdict", kind)], seed,
+        ))
+    trials.append(_async_trial(
+        "async-dispatch-raise", checks, oracle_v,
+        [FaultSpec("jax_backend.dispatch", "raise")], seed,
+    ))
 
     # Persistent corruption: every retry fails, the ladder must walk all
     # the way down and finish on the host-exact rung.

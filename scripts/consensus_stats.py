@@ -76,6 +76,12 @@ REQUIRED_METRICS = [
     # counters only light up under scripts/consensus_chaos.py)
     "consensus_resilience_level",
     "consensus_resilience_sentinel_lanes_total",
+    # in-flight dispatch queue (every guarded dispatch rides a ticket;
+    # the deadline/redispatch/backpressure counters only light up under
+    # scripts/consensus_chaos.py or a saturated pipeline)
+    "consensus_inflight_depth",
+    "consensus_inflight_tickets_total",
+    "consensus_inflight_settle_seconds",
     # spans
     "consensus_span_duration_seconds",
 ]

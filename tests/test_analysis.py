@@ -184,6 +184,28 @@ def test_host_lint_timing_rules_subset(tmp_path):
     assert "obs spans" in findings[0].msg
 
 
+def test_host_lint_sync_rule_flags_hidden_blocking(tmp_path):
+    # The dispatch path may not force device buffers to host outside the
+    # settle seam: bare np.asarray / .block_until_ready / jax.device_get
+    # are hidden synchronization points that re-serialize the pipeline.
+    p = tmp_path / "pipeline.py"
+    p.write_text(
+        "def drive(x, y):\n"
+        "    a = x.block_until_ready()\n"
+        "    b = np.asarray(y)\n"
+        "    c = jax.device_get(y)\n"
+        "    return a, b, c\n"
+        "def _materialize_guarded(x):\n"
+        "    return np.asarray(x)\n"  # the settle seam itself is exempt
+        "def settle_array(x):\n"
+        "    return np.asarray(x)\n"  # the sanctioned helper is exempt
+    )
+    findings = host_lint.lint_paths([str(p)], rules=host_lint.SYNC_RULES)
+    assert [f.rule for f in findings] == ["sync"] * 3
+    assert [f.line for f in findings] == [2, 3, 4]
+    assert all("settle" in f.msg for f in findings)
+
+
 def test_host_lint_clean_on_consensus_path():
     # Covers crypto/ (timing rule) as well as core/ + models/ (full rules):
     # the instrumented pipeline itself must satisfy its own lint.

@@ -32,7 +32,11 @@ from bitcoinconsensus_tpu.core.sighash import (
 )
 from bitcoinconsensus_tpu.core.tx import OutPoint, Tx, TxIn, TxOut
 from bitcoinconsensus_tpu.crypto import secp_host as H
-from bitcoinconsensus_tpu.models.batch import BatchItem, verify_batch
+from bitcoinconsensus_tpu.models.batch import (
+    BatchItem,
+    verify_batch,
+    verify_batch_stream,
+)
 from bitcoinconsensus_tpu.utils.hashes import hash160, tagged_hash
 
 from test_api_verify import (
@@ -203,6 +207,27 @@ def test_batch_matches_single_mixed():
 
 def test_batch_empty():
     assert verify_batch([]) == []
+
+
+def test_batch_stream_matches_per_batch_verify():
+    """verify_batch_stream must yield, per input batch and in order,
+    results identical to a sequential verify_batch — the pipelining is a
+    latency optimization, never a semantic one. (Takes the index-mode
+    overlap path with the native core, the sync fallback without; both
+    must hold.)"""
+    batches = []
+    for seed, corrupt in (("s1", False), ("s2", True), ("s3", False)):
+        txb, spk, amt = make_p2wpkh_spend(seed, corrupt=corrupt)
+        item = BatchItem(txb, 0, VERIFY_ALL_LIBCONSENSUS,
+                         spent_output_script=spk, amount=amt)
+        batches.append([item, _legacy_item(P2PKH_SPENT, 0, P2PKH_SPENDING)])
+    want = [verify_batch(list(b)) for b in batches]
+    got = list(verify_batch_stream(iter(batches), depth=2))
+    assert len(got) == len(want)
+    for g, w in zip(got, want, strict=True):
+        assert [(r.ok, r.error, r.script_error) for r in g] == [
+            (r.ok, r.error, r.script_error) for r in w
+        ]
 
 
 def test_batch_transport_error_order_matches_single():

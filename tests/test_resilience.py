@@ -62,19 +62,25 @@ def _stub_verifier(checks, explode=0):
     transient/persistent dispatch-failure knob."""
     v = TpuSecpVerifier(min_batch=8)
     oracle = np.asarray([v._host_check(c) for c in checks], dtype=bool)
-    exp = [e for _, _, e in G._SENTINEL_SCALARS]
+    # Sentinel templates rotate across dispatches, so the stand-in
+    # recognizes each installed lane by its packed bytes (as a real
+    # device recomputes it from the fields) instead of assuming order.
+    exp_by_raw = {raw: exp for raw, *_rest, exp in G._sentinel_templates()}
     state = {"fails": explode, "calls": 0}
 
     def kernel(args, n):
         state["calls"] += 1
+        F.maybe_raise("jax_backend.dispatch")  # same seam as _run_kernel
         if state["fails"] > 0:
             state["fails"] -= 1
             raise RuntimeError("injected dispatch explosion")
-        padded = int(args[0].shape[0])
+        fields, valid = args[0], args[-1]
+        padded = int(fields.shape[0])
         ok = np.zeros(padded, dtype=bool)
         ok[:n] = oracle[:n]
-        for i in range(min(padded - n, len(exp))):
-            ok[n + i] = exp[i]
+        for pos in range(n, padded):
+            if valid[pos]:
+                ok[pos] = exp_by_raw[fields[pos].tobytes()]
         return ok, np.zeros(padded, dtype=bool)
 
     v._run_kernel = kernel
@@ -180,7 +186,7 @@ def test_validate_verdict_anomaly_classes():
 
 def test_sentinel_install_and_check():
     args = _sentinel_args(size=8)
-    sset = G.install_sentinels(args, 5)
+    sset = G.install_sentinels(args, 5, rotation=0)
     assert sset is not None
     assert sset.positions.tolist() == [5, 6, 7]
     assert sset.expected.tolist() == [True, False, True]
@@ -199,7 +205,8 @@ def test_sentinel_needs_host_lanes_excluded():
     """A sentinel lane the fast-add kernel deferred reports ok=False by
     design; it must be excluded, not miscounted as corruption."""
     args = _sentinel_args(size=8)
-    sset = G.install_sentinels(args, 6)  # positions 6 (True), 7 (False)
+    # rotation pinned: positions 6 (True), 7 (False)
+    sset = G.install_sentinels(args, 6, rotation=0)
     ok = np.zeros(8, dtype=bool)  # position 6 WRONG if it were compared
     needs = np.zeros(8, dtype=bool)
     needs[6] = True
@@ -211,6 +218,46 @@ def test_sentinel_skip_no_room_and_readonly():
     skipped = G._SENTINEL_SKIPPED.value(reason="readonly")
     assert G.install_sentinels(_sentinel_args(size=8, readonly=True), 4) is None
     assert G._SENTINEL_SKIPPED.value(reason="readonly") == skipped + 1
+
+
+def test_sentinel_rotation_and_writable_copy():
+    """Consecutive dispatches carry different expected patterns (a stuck
+    replayed buffer mismatches), and read-only packed batches are copied
+    writable so no dispatch goes out sentinel-less."""
+    seen = set()
+    for _ in range(len(G._SENTINEL_SCALARS)):
+        sset = G.install_sentinels(_sentinel_args(size=8), 6)
+        seen.add(tuple(sset.expected.tolist()))
+    assert len(seen) > 1  # the phase really rotates
+    ro = _sentinel_args(size=8, readonly=True)
+    copies = G._WRITABLE_COPIES.value()
+    args, copied = G.ensure_writable(ro)
+    assert copied and G._WRITABLE_COPIES.value() == copies + 1
+    assert all(a.flags.writeable for a in args)
+    assert G.install_sentinels(args, 4, rotation=0) is not None
+    args2, copied2 = G.ensure_writable(args)
+    assert args2 is args and not copied2  # already writable: passthrough
+
+
+def test_verdict_checksum_catches_single_flip():
+    """The closed containment floor: a single-lane flip anywhere in the
+    buffer — real-lane region included — mismatches the device sums."""
+    ok = np.zeros(16, dtype=bool)
+    ok[3] = ok[9] = True
+    sums = G.verdict_checksum_host(ok)
+    G.check_checksum(sums, ok, "t")  # clean: no raise
+    G.check_checksum(None, ok, "t")  # checksum-less dispatch: no-op
+    for lane in range(16):  # every position is above the floor
+        flipped = ok.copy()
+        flipped[lane] = not flipped[lane]
+        with pytest.raises(G.VerdictAnomaly) as ei:
+            G.check_checksum(sums, flipped, "t")
+        assert ei.value.reason == "checksum"
+    # a swap that preserves the count is caught by the weighted sum
+    swapped = ok.copy()
+    swapped[3], swapped[4] = False, True
+    with pytest.raises(G.VerdictAnomaly):
+        G.check_checksum(sums, swapped, "t")
 
 
 # ---------------------------------------------------------------------------
@@ -288,7 +335,9 @@ def test_guarded_dispatch_clean_path():
     assert G._SENTINEL_LANES.value() > lanes_before
 
 
-@pytest.mark.parametrize("kind", ["invert", "value", "nan", "garbage", "shape"])
+@pytest.mark.parametrize(
+    "kind", ["invert", "flip", "value", "nan", "garbage", "shape"]
+)
 def test_transient_verdict_corruption_contained(kind):
     checks = _checks(6)
     v, oracle, state = _stub_verifier(checks)
@@ -435,7 +484,8 @@ def test_batch_audit_catches_poisoned_hit():
 def test_chaos_soak_bit_identical():
     import random
 
-    kinds = ["invert", "value", "nan", "garbage", "shape", "raise", "timeout"]
+    kinds = ["invert", "flip", "value", "nan", "garbage", "shape", "raise",
+             "timeout"]
     checks = _checks(6)
     for seed in range(40):
         rng = random.Random(seed)
