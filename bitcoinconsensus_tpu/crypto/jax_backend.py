@@ -648,10 +648,12 @@ class TpuSecpVerifier:
         args, _copied = _guards.ensure_writable(args)
         return args, _guards.install_sentinels(args, n)
 
-    def _launch_ticket(self, args: Tuple, n: int, level: str):
+    def _launch_ticket(self, args: Tuple, n: int, level: str, sset=None):
         """Launch one chunk at `level` (inflight queue callback); chains
         the device-side verdict checksum onto the still-async ok buffer.
-        Returns (result, aux) with nothing synchronized."""
+        `sset` is the prepare output (sentinel set; the sharded subclass
+        passes its shard layout and routes on it). Returns (result, aux)
+        with nothing synchronized."""
         result = self._run_level(args, n, level)
         aux = None
         if self._checksum:

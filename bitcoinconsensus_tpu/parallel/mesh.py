@@ -15,6 +15,22 @@ equivalent (SURVEY §2.2) shards the *signature-check batch* across chips:
   `CCheckQueueControl::Wait()`'s all-inputs-valid barrier
   (`checkqueue.h:139-142,188-195`).
 
+Where `CCheckQueueControl::Wait()` assumes every worker answers, a mesh
+must not: this module gives every device shard its own **fault domain**.
+Each shard reserves the *last* lane of its slice for a rotating
+known-answer sentinel, the sharded step returns a per-shard verdict
+checksum pair (lane count + mod-251 position-weighted sum, computed
+inside `shard_map` and recomputed host-side at settle), and the settle
+seam validates shards *independently*: a flip on chip 3 is localized to
+chip 3, whose lanes alone re-dispatch (surviving mesh → single-device
+XLA → host-exact) while the other seven shards' verdicts stand. A
+persistently sick device is *evicted* — the mesh is rebuilt and the
+sharded step re-jitted over the survivors (`ShardLadder` in
+`resilience/degrade.py`) — and later re-probed with a known-answer batch
+for re-promotion. Per-shard stragglers have their own deadline
+(`BITCOINCONSENSUS_TPU_SHARD_DEADLINE_S`), distinct from the whole-ticket
+deadline of the in-flight queue.
+
 Multi-host: the same mesh spec over `jax.devices()` spanning hosts rides
 ICI/DCN transparently through pjit — no NCCL/MPI translation layer exists or
 is needed.
@@ -22,6 +38,7 @@ is needed.
 
 from __future__ import annotations
 
+import os
 from typing import Optional, Sequence
 
 import numpy as np
@@ -45,12 +62,22 @@ _SHARD_MAP_KW = (
 )
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from ..crypto.jax_backend import SigCheck, TpuSecpVerifier, _verify_kernel
+from ..crypto import jax_backend as _jb
+from ..crypto.jax_backend import (
+    SigCheck,
+    TpuSecpVerifier,
+    _verdict_checksum,
+    _verify_kernel,
+)
 from ..obs import counter as _obs_counter
 from ..obs import gauge as _obs_gauge
 from ..obs import histogram as _obs_histogram
+from ..obs import monotonic as _monotonic
+from ..obs import span as _obs_span
 from ..resilience import degrade as _degrade
 from ..resilience import faults as _faults
+from ..resilience import guards as _guards
+from ..resilience.inflight import settle_array
 
 __all__ = ["make_mesh", "ShardedSecpVerifier", "make_sharded_step"]
 
@@ -64,17 +91,57 @@ _MESH_DISPATCH = _obs_counter(
 )
 _MESH_SHARD_LANES = _obs_histogram(
     "consensus_mesh_shard_lanes",
-    "per-device shard size (lanes) of each sharded dispatch",
+    "live (real, non-sentinel/pad) lanes per device shard per dispatch",
     buckets=(8, 64, 512, 4096, 32768),
+)
+_MESH_SHARD_FAILURES = _obs_counter(
+    "consensus_mesh_shard_failures_total",
+    "per-shard settle failures (guard anomaly, checksum mismatch, "
+    "straggler deadline, device loss), by device and reason",
+    ("device", "reason"),
+)
+_MESH_EVICTIONS = _obs_counter(
+    "consensus_mesh_evictions_total",
+    "devices evicted from the mesh after repeated shard failures",
+    ("device",),
+)
+_MESH_REPROMOTIONS = _obs_counter(
+    "consensus_mesh_repromotions_total",
+    "evicted devices re-promoted into the mesh after a clean probe",
+    ("device",),
+)
+_MESH_REDISPATCH_LANES = _obs_counter(
+    "consensus_mesh_redispatch_lanes_total",
+    "lanes re-dispatched after their shard failed settle, by the level "
+    "that answered (mesh = surviving shards, xla = single device, "
+    "host = exact oracle)",
+    ("level",),
 )
 
 
-def make_mesh(n_devices: Optional[int] = None, axis: str = "batch") -> Mesh:
-    """1-D device mesh over the batch axis."""
-    devs = jax.devices()
-    if n_devices is not None:
-        devs = devs[:n_devices]
-    return Mesh(np.asarray(devs), (axis,))
+def make_mesh(
+    n_devices: Optional[int] = None,
+    axis: str = "batch",
+    devices: Optional[Sequence] = None,
+) -> Mesh:
+    """1-D device mesh over the batch axis.
+
+    Pass `devices` to build over an explicit device list (the elastic
+    verifier rebuilds over eviction survivors this way). Asking for more
+    devices than the platform has is an error, not a silent truncation —
+    a deployment that believes it runs 8-wide must not quietly run 1-wide.
+    """
+    if devices is None:
+        devices = jax.devices()
+        if n_devices is not None:
+            if n_devices > len(devices):
+                raise ValueError(
+                    f"make_mesh: requested {n_devices} devices but only "
+                    f"{len(devices)} are available "
+                    f"(platform {devices[0].platform})"
+                )
+            devices = devices[:n_devices]
+    return Mesh(np.asarray(devices), (axis,))
 
 
 def _pick_backend(use_pallas: bool):
@@ -104,17 +171,23 @@ def make_sharded_step(mesh: Mesh, use_pallas: Optional[bool] = None):
     """The full multichip verify step, jitted over `mesh`.
 
     Returns ``step(fields, want_odd, parity_req, has_t2, neg1, neg2,
-    valid, live) -> (per_lane, needs_host, all_ok)`` where inputs are
-    batch-sharded, `per_lane`/`needs_host` come back batch-sharded, and
-    `all_ok` is a replicated scalar produced by a psum AND-reduction inside
-    shard_map (the cross-chip collective — the `CCheckQueueControl::Wait`
-    analogue, checkqueue.h:139-142). `live` marks real lanes: padding added
-    to reach the batch shape is not counted as a failure, while
-    structurally-invalid real lanes are. `needs_host` lanes (exceptional
-    group-law deferrals of the pallas fast adds) are excluded from the
-    device verdict — the host resolves them exactly and adjusts. Each shard
-    runs the production backend selection (Pallas on TPU when the local
-    tile divides; XLA otherwise).
+    valid, live) -> (per_lane, needs_host, all_ok, counts, wsums)`` where
+    inputs are batch-sharded, `per_lane`/`needs_host` come back
+    batch-sharded, `all_ok` is a replicated scalar produced by a psum
+    AND-reduction inside shard_map (the cross-chip collective — the
+    `CCheckQueueControl::Wait` analogue, checkqueue.h:139-142), and
+    `counts`/`wsums` are length-``n_devices`` arrays carrying each
+    shard's verdict checksum pair, computed on-device over the
+    shard-local verdict slice (`jax_backend._verdict_checksum`, so the
+    interval prover's coverage rides along). The settle seam recomputes
+    both sums host-side per shard; a mismatch convicts exactly that
+    shard. `live` marks real lanes: padding added to reach the batch
+    shape is not counted as a failure, while structurally-invalid real
+    lanes are. `needs_host` lanes (exceptional group-law deferrals of the
+    pallas fast adds) are excluded from the device verdict — the host
+    resolves them exactly and adjusts. Each shard runs the production
+    backend selection (Pallas on TPU when the local tile divides; XLA
+    otherwise).
     """
     axis = mesh.axis_names[0]
     fields_sharding = NamedSharding(mesh, P(axis, None, None))
@@ -131,7 +204,14 @@ def make_sharded_step(mesh: Mesh, use_pallas: Optional[bool] = None):
         # all-valid <=> no live lane DEFINITELY failed, on any shard
         # (deferred lanes stay out; the host fixup ANDs their verdicts in).
         failures = jnp.sum(jnp.where(live & ~per_lane & ~needs, 1, 0))
-        return per_lane, needs, jax.lax.psum(failures, axis) == 0
+        cnt, wsum = _verdict_checksum(per_lane)
+        return (
+            per_lane,
+            needs,
+            jax.lax.psum(failures, axis) == 0,
+            jnp.reshape(cnt, (1,)),
+            jnp.reshape(wsum, (1,)),
+        )
 
     # Varying-axes checking is off: the verify kernel's scan carries start
     # as mesh-wide constants (infinity masks, G-table selects) and become
@@ -141,36 +221,110 @@ def make_sharded_step(mesh: Mesh, use_pallas: Optional[bool] = None):
         local_step,
         mesh=mesh,
         in_specs=(P(axis, None, None),) + (P(axis),) * 7,
-        out_specs=(P(axis), P(axis), P()),
+        out_specs=(P(axis), P(axis), P(), P(axis), P(axis)),
         **_SHARD_MAP_KW,
     )
     return jax.jit(
         sharded,
         in_shardings=(fields_sharding,) + (flat_sharding,) * 7,
-        out_shardings=(flat_sharding, flat_sharding, replicated),
+        out_shardings=(
+            flat_sharding, flat_sharding, replicated,
+            flat_sharding, flat_sharding,
+        ),
     )
 
 
+def _shard_positions(n: int, shard_size: int) -> np.ndarray:
+    """Global row index of real lane `i` under the scatter layout.
+
+    Each shard of `shard_size` rows holds `shard_size - 1` real lanes
+    followed by its reserved sentinel row, so lane i lands at
+    ``(i // (S-1)) * S + (i % (S-1))``.
+    """
+    cap = shard_size - 1
+    idx = np.arange(n, dtype=np.int64)
+    return (idx // cap) * shard_size + (idx % cap)
+
+
+class _ShardLayout:
+    """Settle context of one scattered mesh dispatch (rides ticket.sset).
+
+    `positions` maps real-lane order to global rows; `ssets` holds one
+    single-lane SentinelSet per shard (local position S-1) for per-shard
+    checking, and `flat_sset` the same sentinels as one global set for
+    the quarantined single-device fallback path. `epoch` pins the mesh
+    generation the layout was built for: after an eviction rebuilds the
+    mesh, stale layouts are no longer shard-aligned and relaunch on the
+    single-device rung instead. `deadline_armed` is False for
+    first-compile shapes, so the per-shard straggler deadline never fires
+    on XLA compilation time.
+    """
+
+    __slots__ = (
+        "n", "padded", "n_shards", "shard_size", "positions", "ssets",
+        "flat_sset", "epoch", "deadline_armed",
+    )
+
+    def __init__(self, n, padded, n_shards, shard_size, positions, ssets,
+                 flat_sset, epoch, deadline_armed):
+        self.n = n
+        self.padded = padded
+        self.n_shards = n_shards
+        self.shard_size = shard_size
+        self.positions = positions
+        self.ssets = ssets
+        self.flat_sset = flat_sset
+        self.epoch = epoch
+        self.deadline_armed = deadline_armed
+
+
+# Pad row values per packed array (mirrors _pack_lanes): fields 0,
+# want_odd 0, parity -1 (don't-care), has_t2/neg1/neg2 0, valid False.
+_PAD_VALUES = (0, 0, -1, 0, 0, 0, 0)
+
+
 class ShardedSecpVerifier(TpuSecpVerifier):
-    """Drop-in TpuSecpVerifier that spreads each dispatch over a mesh."""
+    """Drop-in TpuSecpVerifier that spreads each dispatch over a mesh,
+    with per-device fault domains: per-shard sentinels + checksums at
+    settle, shard-granular re-dispatch, and elastic device eviction."""
 
     def __init__(self, mesh: Optional[Mesh] = None, min_batch: int = 8,
-                 chunk: int = 1 << 13):
+                 chunk: int = 1 << 13, evict_after: Optional[int] = None):
         super().__init__(min_batch=min_batch, chunk=chunk)
-        self.mesh = mesh if mesh is not None else make_mesh()
-        n = self.mesh.devices.size
-        # Batch sizes must divide evenly across the mesh: round min_batch up
-        # to a multiple of n (doubling in _pad preserves divisibility).
-        self._min_batch = -(-self._min_batch // n) * n
-        tpu_mesh = all(d.platform == "tpu" for d in self.mesh.devices.flat)
-        self._step = make_sharded_step(
-            self.mesh, use_pallas=self._use_pallas and tpu_mesh
+        mesh = mesh if mesh is not None else make_mesh()
+        self._axis = mesh.axis_names[0]
+        self._all_devices = list(mesh.devices.flat)
+        self._base_min_batch = min_batch
+        self._mesh_epoch = 0
+        self._shard_ladder = _degrade.ShardLadder(
+            [str(d.id) for d in self._all_devices], evict_after=evict_after
         )
+        self._shard_deadline_s = float(os.environ.get(
+            "BITCOINCONSENSUS_TPU_SHARD_DEADLINE_S", "4.0"
+        ))
         self._verdict_acc = True
         self._dispatched = 0
-        _MESH_DEVICES.set(n)
+        self._install_mesh(mesh)
 
     _SITE = "mesh"
+
+    def _install_mesh(self, mesh: Mesh) -> None:
+        """(Re)build the sharded step over `mesh`; logs the effective
+        mesh size via obs (gauge + a traced `mesh.build` span) — also the
+        eviction/re-promotion rebuild path, where re-jitting over the
+        survivors is the dominant cost and worth a span of its own."""
+        n = int(mesh.devices.size)
+        self.mesh = mesh
+        self._shard_device_ids = [str(d.id) for d in mesh.devices.flat]
+        # Batch sizes must divide evenly across the mesh: round min_batch
+        # up to a multiple of n (doubling in _pad preserves divisibility).
+        self._min_batch = -(-self._base_min_batch // n) * n
+        tpu_mesh = all(d.platform == "tpu" for d in mesh.devices.flat)
+        with _obs_span("mesh.build", devices=n, epoch=self._mesh_epoch):
+            self._step = make_sharded_step(
+                mesh, use_pallas=self._use_pallas and tpu_mesh
+            )
+        _MESH_DEVICES.set(n)
 
     def _ladder_levels(self):
         # Quarantined mesh dispatch falls back to the single-device base
@@ -178,27 +332,418 @@ class ShardedSecpVerifier(TpuSecpVerifier):
         # host EC math while one chip still answers correctly.
         return ("mesh", "xla", _degrade.HOST_LEVEL)
 
-    def _run_kernel(self, args, n: int):
-        if self._dispatch_level == "xla":
-            # Ladder-quarantined mesh rung: single-device base dispatch.
-            return TpuSecpVerifier._run_kernel(self, args, n)
+    # --- layout ---------------------------------------------------------
+
+    def _pad(self, n: int) -> int:
+        # Reserve one sentinel lane PER SHARD (not one per dispatch): the
+        # padded size must fit n real lanes plus n_devices sentinels, and
+        # every shard must be >= 2 rows so its sentinel never crowds out
+        # real work. min_batch is a multiple of n_devices, so doubling
+        # preserves divisibility.
+        d = int(self.mesh.devices.size)
+        size = self._min_batch
+        while size < n + d or size // max(d, 1) < 2:
+            size *= 2
+        return size
+
+    @property
+    def lane_capacity(self) -> int:
+        """Real lanes per chunk dispatch: one short PER SHARD of `chunk`,
+        so the per-shard sentinel rows never push a full chunk up a pad
+        rung."""
+        return self._chunk - int(self.mesh.devices.size)
+
+    def _blank_args(self, like, padded: int):
+        """Fresh all-pad packed buffers shaped like `like` at `padded`."""
+        out = []
+        for a, pv in zip(like, _PAD_VALUES):
+            buf = np.zeros((padded,) + a.shape[1:], dtype=a.dtype)
+            if pv:
+                buf[...] = pv
+            out.append(buf)
+        return tuple(out)
+
+    def _build_layout(self, args, n: int) -> Optional[_ShardLayout]:
+        """Scatter real lanes across shards in place + install per-shard
+        sentinels; None when the buffer cannot carry the layout (caller
+        falls back to the contiguous single-sentinel prep)."""
+        d = int(self.mesh.devices.size)
+        padded = int(args[0].shape[0])
+        if d < 2 or padded % d or padded < n + d:
+            return None
+        shard = padded // d
+        if shard < 2 or n > d * (shard - 1):
+            return None
+        positions = _shard_positions(n, shard)
+        for a, pv in zip(args, _PAD_VALUES):
+            real = a[:n].copy()
+            a[...] = pv
+            a[positions] = real
+        sent_rows = [s * shard + shard - 1 for s in range(d)]
+        flat = _guards.install_sentinels_at(args, sent_rows)
+        if flat is None:
+            return None
+        ssets = [
+            _guards.SentinelSet([shard - 1], [bool(flat.expected[s])])
+            for s in range(d)
+        ]
+        return _ShardLayout(
+            n, padded, d, shard, positions, ssets, flat,
+            self._mesh_epoch, padded in self._seen_shapes,
+        )
+
+    def _prepare_ticket(self, args, n: int):
+        """Dispatch-time prep (inflight queue callback): copy read-only
+        buffers, then lay the batch out shard-major with one rotating
+        known-answer sentinel per device shard. Falls back to the base
+        contiguous sentinel prep when the batch cannot shard."""
+        args, _copied = _guards.ensure_writable(args)
+        layout = self._build_layout(args, n)
+        if layout is None:
+            return args, _guards.install_sentinels(args, n)
+        return args, layout
+
+    # --- launch ---------------------------------------------------------
+
+    def _launch_ticket(self, args, n: int, level: str, sset=None):
+        """Launch one chunk (inflight queue callback). Mesh-level launches
+        need a current-epoch shard layout; anything else (quarantined
+        rung, stale layout after an eviction rebuild, unshardable batch)
+        runs the single-device base dispatch, whose settle is guarded by
+        the flat sentinel set + global checksum."""
+        layout = sset if isinstance(sset, _ShardLayout) else None
+        if (
+            level != "mesh"
+            or layout is None
+            or layout.epoch != self._mesh_epoch
+        ):
+            if level == "mesh":
+                level = "xla"
+            return TpuSecpVerifier._launch_ticket(self, args, n, level, sset)
         _faults.maybe_raise("mesh.dispatch")
-        padded = int(args[-1].shape[0])
-        live = np.zeros(padded, dtype=bool)
-        live[:n] = True  # sentinel/pad lanes stay out of the psum verdict
-        self._note_dispatch(padded, n, "mesh")
+        live = np.zeros(layout.padded, dtype=bool)
+        live[layout.positions] = True  # sentinel/pad lanes stay out of psum
+        self._note_dispatch(layout.padded, n, "mesh")
         _MESH_DISPATCH.inc()
-        _MESH_SHARD_LANES.observe(padded // self.mesh.devices.size)
-        return self._step(*args, live)
+        cap = layout.shard_size - 1
+        for s in range(layout.n_shards):
+            _MESH_SHARD_LANES.observe(min(max(n - s * cap, 0), cap))
+        # Per-shard checksums ride inside the 5-tuple result; no extra aux.
+        return self._step(*args, live), None
+
+    # --- settle ---------------------------------------------------------
+
+    def _materialize_guarded(self, ticket):
+        result = ticket.result
+        layout = ticket.sset if isinstance(ticket.sset, _ShardLayout) else None
+        if layout is None:
+            # Contiguous prep (unshardable batch): base settle seam.
+            return TpuSecpVerifier._materialize_guarded(self, ticket)
+        if not (isinstance(result, tuple) and len(result) == 5):
+            return self._materialize_flat(ticket, layout)
+        return self._materialize_sharded(ticket, layout)
+
+    def _materialize_flat(self, ticket, layout: _ShardLayout):
+        """Settle a scattered buffer answered by the single-device rung:
+        whole-buffer guards (flat sentinels + global checksum), then
+        gather real lanes back to caller order."""
+        result = ticket.result
+        needs_raw = None
+        if isinstance(result, tuple):
+            ok_raw, needs_raw = result[0], result[1]
+        else:
+            ok_raw = result
+        ok_np = _faults.corrupt_verdict(
+            "jax_backend.verdict", settle_array(ok_raw)
+        )
+        ok = _guards.validate_verdict(ok_np, layout.padded, self._SITE)
+        needs = None
+        if needs_raw is not None:
+            needs = _guards.validate_verdict(
+                settle_array(needs_raw), layout.padded, self._SITE
+            )
+        _guards.check_sentinels(layout.flat_sset, ok, needs, self._SITE)
+        if ticket.aux is not None:
+            dev_sums = (int(settle_array(ticket.aux[0])),
+                        int(settle_array(ticket.aux[1])))
+            _guards.check_checksum(dev_sums, ok, self._SITE)
+        ok_r = ok[layout.positions]
+        needs_r = None if needs is None else needs[layout.positions]
+        return ok_r, needs_r, None
+
+    def _materialize_sharded(self, ticket, layout: _ShardLayout):
+        """The per-shard settle seam: validate every device shard
+        independently (structural guards, per-shard checksum FIRST — the
+        single-flip detector — then the shard's sentinel), feed per-device
+        health, and re-dispatch only the failed shards' lanes."""
+        per_lane, needs, all_ok, cnts, wsums = ticket.result
+        ok_np = settle_array(per_lane)
+        needs_np = settle_array(needs)
+        cnts_np = settle_array(cnts)
+        wsums_np = settle_array(wsums)
+        if (
+            ok_np.ndim != 1
+            or ok_np.shape[0] != layout.padded
+            or needs_np.shape != ok_np.shape
+            or cnts_np.shape[0] != layout.n_shards
+            or wsums_np.shape[0] != layout.n_shards
+        ):
+            _guards.GUARD_ANOMALIES.inc(site=self._SITE, reason="shape")
+            raise _guards.VerdictAnomaly(
+                self._SITE, "shape",
+                f"got {ok_np.shape}/{cnts_np.shape}, "
+                f"want ({layout.padded},)/({layout.n_shards},)",
+            )
+        elapsed = _monotonic() - ticket.born
+        ok_v, needs_v, bad = self._check_shards(
+            ok_np, needs_np, cnts_np, wsums_np, layout, elapsed
+        )
+        # Per-device health feeds the eviction ladder at the PRIMARY
+        # settle only (re-dispatch retries must not double-convict).
+        # Evictions apply after the loop: each one rebuilds the mesh and
+        # shrinks _shard_device_ids, which this loop still indexes by the
+        # layout's (pre-eviction) shard count.
+        devs = list(self._shard_device_ids)
+        to_evict = []
+        for s in range(layout.n_shards):
+            dev = devs[s]
+            if s in bad:
+                _MESH_SHARD_FAILURES.inc(device=dev, reason=bad[s])
+            if self._shard_ladder.report_shard(dev, s not in bad):
+                to_evict.append(dev)
+        for dev in to_evict:
+            self._evict_device(dev)
+        if len(bad) == layout.n_shards:
+            # Nothing survived: whole-mesh fault — let the ticket's
+            # retry/ladder policy decide (same as the pre-shard-domain
+            # behavior).
+            raise _guards.VerdictAnomaly(
+                self._SITE, "all-shards", ",".join(sorted(set(bad.values())))
+            )
+        if not bad:
+            probe_dev = self._shard_ladder.note_clean_dispatch()
+            if probe_dev is not None:
+                self._probe_evicted(probe_dev)
+            return (
+                ok_v[layout.positions],
+                needs_v[layout.positions],
+                bool(settle_array(all_ok)),
+            )
+        # Partial settlement: keep the good shards' verdicts, re-dispatch
+        # only the failed shards' real lanes. all_ok=None tells the
+        # verdict accounting to recompute from the assembled lanes (the
+        # psum scalar saw the faulted shards).
+        cap = layout.shard_size - 1
+        lane_shard = np.arange(layout.n, dtype=np.int64) // cap
+        bad_keys = np.fromiter(bad.keys(), dtype=np.int64, count=len(bad))
+        bad_mask = np.isin(lane_shard, bad_keys)
+        ok_r = np.zeros(layout.n, dtype=bool)
+        needs_r = np.zeros(layout.n, dtype=bool)
+        good = ~bad_mask
+        ok_r[good] = ok_v[layout.positions[good]]
+        needs_r[good] = needs_v[layout.positions[good]]
+        k = int(bad_mask.sum())
+        if k:
+            rows = layout.positions[bad_mask]
+            sub = tuple(a[rows] for a in ticket.args)
+            ok_b, needs_b = self._redispatch_lanes(sub, k)
+            ok_r[bad_mask] = ok_b
+            needs_r[bad_mask] = needs_b
+        return ok_r, needs_r, None
+
+    def _check_shards(self, ok_np, needs_np, cnts_np, wsums_np,
+                      layout: _ShardLayout, elapsed: float):
+        """Validate each shard's verdict slice independently.
+
+        Returns `(ok, needs, bad)` where ok/needs are padded bool buffers
+        holding the surviving shards' validated slices and `bad` maps
+        shard index -> failure reason. Check order is deliberate:
+        structural validation, then the per-shard checksum (so a
+        single-lane flip always convicts as "checksum" — the chaos
+        sweep's hard criterion), then the shard's rotating sentinel.
+        """
+        shard = layout.shard_size
+        ok_v = np.zeros(layout.padded, dtype=bool)
+        needs_v = np.zeros(layout.padded, dtype=bool)
+        bad = {}
+        for s in range(layout.n_shards):
+            site = f"mesh.shard.{s}"
+            sl = slice(s * shard, (s + 1) * shard)
+            try:
+                _faults.maybe_raise(site)
+                delay = _faults.shard_delay(site)
+                # Convict on per-SHARD lag only (today the harness's
+                # simulated delay; device completion events on real
+                # hardware). Whole-dispatch slowness — compile stalls, a
+                # loaded host — is the in-flight ticket deadline's job:
+                # folding it in here would convict all shards at once on
+                # a slow machine with no fault present.
+                if (
+                    layout.deadline_armed
+                    and delay > 0.0
+                    and elapsed + delay > self._shard_deadline_s
+                ):
+                    _guards.GUARD_ANOMALIES.inc(site=site, reason="deadline")
+                    bad[s] = "deadline"
+                    continue
+                ok_s = _guards.validate_verdict(
+                    _faults.corrupt_verdict(site, ok_np[sl]), shard, site
+                )
+                needs_s = _guards.validate_verdict(needs_np[sl], shard, site)
+                _guards.check_checksum(
+                    (int(cnts_np[s]), int(wsums_np[s])), ok_s, site
+                )
+                layout.ssets[s].check(ok_s, needs_s, site)
+            except _guards.VerdictAnomaly as exc:
+                bad[s] = exc.reason
+            except _faults.InjectedDeviceLoss:
+                bad[s] = "device-loss"
+            except _faults.InjectedTimeout:
+                bad[s] = "timeout"
+            except Exception:
+                bad[s] = "dispatch"
+            else:
+                ok_v[sl] = ok_s
+                needs_v[sl] = needs_s
+        return ok_v, needs_v, bad
+
+    # --- shard re-dispatch ---------------------------------------------
+
+    def _redispatch_lanes(self, sub, k: int):
+        """Re-answer `k` lanes whose shard failed settle: surviving mesh
+        first, then the single-device XLA rung, then fail closed to the
+        host oracle (lanes come back needs_host=True, so the settle layer
+        resolves them exactly — a shard fault never yields an ACCEPT)."""
+        for target in ("mesh", "xla"):
+            try:
+                if target == "mesh":
+                    out = self._redispatch_mesh(sub, k)
+                else:
+                    out = self._redispatch_xla(sub, k)
+            except Exception:
+                out = None
+            if out is not None:
+                _MESH_REDISPATCH_LANES.inc(k, level=target)
+                return out
+        _MESH_REDISPATCH_LANES.inc(k, level="host")
+        _guards.CONTAINED.inc(site=self._SITE)
+        _guards.HOST_EXACT_LANES.inc(k)
+        return np.zeros(k, dtype=bool), np.ones(k, dtype=bool)
+
+    def _redispatch_mesh(self, sub, k: int):
+        """One synchronous dispatch of the failed lanes over the current
+        (possibly rebuilt) mesh, re-guarded shard-by-shard; None when the
+        mesh cannot answer cleanly (caller falls to the next rung)."""
+        args = self._blank_args(sub, self._pad(k))
+        for a, r in zip(args, sub):
+            a[:k] = r
+        layout = self._build_layout(args, k)
+        if layout is None:
+            return None
+        live = np.zeros(layout.padded, dtype=bool)
+        live[layout.positions] = True
+        self._note_dispatch(layout.padded, k, "mesh")
+        _MESH_DISPATCH.inc()
+        per_lane, needs, _all_ok, cnts, wsums = self._step(*args, live)
+        ok_v, needs_v, bad = self._check_shards(
+            settle_array(per_lane), settle_array(needs),
+            settle_array(cnts), settle_array(wsums), layout, 0.0,
+        )
+        if bad:
+            return None
+        return ok_v[layout.positions], needs_v[layout.positions]
+
+    def _redispatch_xla(self, sub, k: int):
+        """Single-device re-answer of the failed lanes, guarded by a
+        fresh contiguous sentinel set + the global verdict checksum."""
+        args = self._blank_args(sub, self._pad(k))
+        for a, r in zip(args, sub):
+            a[:k] = r
+        sset = _guards.install_sentinels(args, k)
+        padded = int(args[0].shape[0])
+        result = self._run_level(args, k, "xla")
+        ok_raw = result[0] if isinstance(result, tuple) else result
+        aux = _jb._checksum_jit(ok_raw) if self._checksum else None
+        ok = _guards.validate_verdict(
+            settle_array(ok_raw), padded, self._SITE
+        )
+        _guards.check_sentinels(sset, ok, None, self._SITE)
+        if aux is not None:
+            dev_sums = (int(settle_array(aux[0])),
+                        int(settle_array(aux[1])))
+            _guards.check_checksum(dev_sums, ok, self._SITE)
+        return ok[:k], np.zeros(k, dtype=bool)
+
+    # --- elastic mesh: eviction + re-promotion -------------------------
+
+    def _evict_device(self, dev_id: str) -> None:
+        """Convict one device: shrink the mesh to the survivors and
+        re-jit the sharded step. In-flight layouts from the old epoch
+        settle on the single-device rung (epoch check at relaunch)."""
+        self._shard_ladder.evict(dev_id)
+        _MESH_EVICTIONS.inc(device=dev_id)
+        self._rebuild_mesh()
+
+    def _rebuild_mesh(self) -> None:
+        healthy = set(self._shard_ladder.healthy())
+        devs = [d for d in self._all_devices if str(d.id) in healthy]
+        self._mesh_epoch += 1
+        self._install_mesh(make_mesh(axis=self._axis, devices=devs))
+
+    def _probe_evicted(self, dev_id: str) -> None:
+        """Known-answer re-promotion probe for an evicted device; a clean
+        probe re-admits it (and re-jits the step over the grown mesh), a
+        failed one leaves it quarantined for the next nomination."""
+        try:
+            ok = self._probe_device(dev_id)
+        except Exception:
+            ok = False
+        if ok:
+            self._shard_ladder.repromote(dev_id)
+            _MESH_REPROMOTIONS.inc(device=dev_id)
+            self._rebuild_mesh()
+
+    def _probe_device(self, dev_id: str) -> bool:
+        """Run an all-sentinel batch pinned to `dev_id`; True iff every
+        known answer comes back right (the mesh analogue of the rung
+        ladder's re-promotion probe — same idea, device-targeted)."""
+        _faults.maybe_raise("mesh.probe")
+        dev = next(
+            (d for d in self._all_devices if str(d.id) == dev_id), None
+        )
+        if dev is None:
+            return False
+        size = 8
+        args = self._blank_args(
+            (np.zeros((1, 4, 32), dtype=np.uint8),) + tuple(
+                np.zeros(1, dtype=np.int32) for _ in range(5)
+            ) + (np.zeros(1, dtype=bool),),
+            size,
+        )
+        sset = _guards.install_sentinels_at(args, [0, 1, 2, 3], rotation=0)
+        if sset is None:
+            return False
+        put = tuple(jax.device_put(a, dev) for a in args)
+        ok = _guards.validate_verdict(
+            settle_array(self._kernel(*put)), size, "mesh.probe"
+        )
+        try:
+            sset.check(ok, None, "mesh.probe")
+        except _guards.VerdictAnomaly:
+            return False
+        return True
+
+    # --- verdict accounting --------------------------------------------
 
     def _note_device_verdict(self, all_ok, ok, needs, count: int) -> None:
         """AND a settled chunk into the block verdict. `all_ok` is the
-        psum collective's replicated scalar for mesh dispatches; for
-        quarantined (single-device) dispatches it is recomputed from the
-        per-lane buffer with the same semantics (deferred lanes excluded —
-        the host fixup ANDs their verdicts in via `_fixup_failed`).
-        Accounting happens at settle, never dispatch, so retried or
-        contained chunks cannot double-count."""
+        psum collective's replicated scalar for fully-clean mesh
+        dispatches; for partially-settled or quarantined (single-device)
+        dispatches it is recomputed from the per-lane buffer with the
+        same semantics (deferred lanes excluded — the host fixup ANDs
+        their verdicts in via `_fixup_failed`). Accounting happens at
+        settle, never dispatch, so retried or contained chunks cannot
+        double-count."""
         if all_ok is None:
             lanes_ok = ok[:count]
             if needs is not None:
@@ -224,10 +769,19 @@ class ShardedSecpVerifier(TpuSecpVerifier):
         self._verdict_acc = True
         self._dispatched = 0
         self._fixup_failed = False
-        res = self.verify_checks(checks)
-        verdict = (
-            self._verdict_acc
-            and self._dispatched == len(checks)
-            and not self._fixup_failed
-        )
-        return res, verdict
+        try:
+            res = self.verify_checks(checks)
+            return res, (
+                self._verdict_acc
+                and self._dispatched == len(checks)
+                and not self._fixup_failed
+            )
+        finally:
+            # A raising verify_checks must not poison the NEXT verdict:
+            # settle whatever is still in flight (those tickets' verdict
+            # callbacks land in the accumulators being reset) and clear
+            # the accounting either way.
+            self._inflight.drain()
+            self._verdict_acc = True
+            self._dispatched = 0
+            self._fixup_failed = False

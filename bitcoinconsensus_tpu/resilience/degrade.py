@@ -31,13 +31,20 @@ driver thread (the same discipline as its `_seen_shapes`).
 
 from __future__ import annotations
 
-from typing import Sequence, Tuple
+import os
+from typing import Dict, List, Optional, Sequence, Tuple
 
 from ..obs import counter as _obs_counter
 from ..obs import gauge as _obs_gauge
 from ..obs import monotonic as _monotonic
 
-__all__ = ["DispatchFailed", "DispatchResilience", "HOST_LEVEL", "Ladder"]
+__all__ = [
+    "DispatchFailed",
+    "DispatchResilience",
+    "HOST_LEVEL",
+    "Ladder",
+    "ShardLadder",
+]
 
 # The ladder's terminal rung: dispatch layers compare against this marker
 # and route straight to their host-exact oracle when quarantined this far.
@@ -138,6 +145,103 @@ class Ladder:
             self._fail_streak = 0
             _DEMOTIONS.inc(ladder=self.name, src=src, dst=self.current)
             _LEVEL.set(self._idx, ladder=self.name)
+
+
+_SHARD_HEALTH = _obs_gauge(
+    "consensus_mesh_healthy_devices",
+    "devices currently in the active mesh (evicted devices excluded)",
+    ("ladder",),
+)
+
+
+class ShardLadder:
+    """Per-device health for an elastic mesh: evict sick, re-probe later.
+
+    Where `Ladder` quarantines a whole *backend rung*, this tracks each
+    device of a sharded dispatch independently: ``evict_after``
+    consecutive shard failures (guard anomalies, checksum mismatches,
+    straggler deadlines, device loss) on one device convicts that device
+    alone — the mesh owner rebuilds over the survivors and the batch
+    keeps flowing. Like the rung ladder, eviction is not forever: every
+    ``reprobe_after``-th clean mesh dispatch nominates the
+    longest-evicted device for a known-answer re-promotion probe.
+
+    Count-based and clockless, so the whole state machine is
+    deterministic and unit-testable; the mesh owner supplies the
+    wall-clock policy (per-shard straggler deadline) separately.
+    """
+
+    def __init__(
+        self,
+        device_ids: Sequence[str],
+        evict_after: Optional[int] = None,
+        reprobe_after: int = 16,
+        min_devices: int = 1,
+    ):
+        if evict_after is None:
+            evict_after = int(
+                os.environ.get("BITCOINCONSENSUS_TPU_MESH_EVICT_AFTER", "3")
+            )
+        if evict_after < 1:
+            raise ValueError("evict_after must be >= 1")
+        self.evict_after = evict_after
+        self.reprobe_after = reprobe_after
+        self.min_devices = min_devices
+        self._all: Tuple[str, ...] = tuple(device_ids)
+        self._fails: Dict[str, int] = {d: 0 for d in self._all}
+        self._evicted: List[str] = []  # FIFO: longest-evicted re-probes first
+        self._clean_streak = 0
+        _SHARD_HEALTH.set(len(self._all), ladder="mesh")
+
+    def healthy(self) -> List[str]:
+        """Device ids currently in the mesh, in original order."""
+        return [d for d in self._all if d not in self._evicted]
+
+    def report_shard(self, device_id: str, ok: bool) -> bool:
+        """Record one shard outcome; True means "evict this device now".
+
+        Never asks for an eviction that would shrink the mesh below
+        ``min_devices`` — a mesh-wide fault then stays a whole-ticket
+        failure for the rung ladder rather than a cascade of evictions.
+        """
+        if device_id in self._evicted:
+            return False
+        if ok:
+            self._fails[device_id] = 0
+            return False
+        self._clean_streak = 0
+        self._fails[device_id] = self._fails.get(device_id, 0) + 1
+        return (
+            self._fails[device_id] >= self.evict_after
+            and len(self.healthy()) > self.min_devices
+        )
+
+    def evict(self, device_id: str) -> None:
+        if device_id not in self._evicted:
+            self._evicted.append(device_id)
+            self._fails[device_id] = 0
+            _SHARD_HEALTH.set(len(self.healthy()), ladder="mesh")
+
+    def note_clean_dispatch(self) -> Optional[str]:
+        """Record a fully clean mesh settle; maybe nominate a re-probe.
+
+        Every ``reprobe_after``-th consecutive clean dispatch returns the
+        longest-evicted device id (the caller runs a known-answer probe
+        on it and calls `repromote` on success); otherwise None.
+        """
+        if not self._evicted:
+            return None
+        self._clean_streak += 1
+        if self._clean_streak >= self.reprobe_after:
+            self._clean_streak = 0
+            return self._evicted[0]
+        return None
+
+    def repromote(self, device_id: str) -> None:
+        if device_id in self._evicted:
+            self._evicted.remove(device_id)
+            self._fails[device_id] = 0
+            _SHARD_HEALTH.set(len(self.healthy()), ladder="mesh")
 
 
 class DispatchResilience:

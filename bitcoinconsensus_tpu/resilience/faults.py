@@ -23,7 +23,13 @@ Sites registered by the pipeline (grep for the literal):
 
     jax_backend.dispatch    raise/timeout at device dispatch
     jax_backend.verdict     corrupt the materialized verdict buffer
-    mesh.dispatch           raise at sharded dispatch (device drop)
+    mesh.dispatch           raise at sharded dispatch (whole-mesh drop)
+    mesh.shard.<i>          per-shard: raise/timeout/device-loss at shard
+                            settle, corrupt that shard's verdict slice,
+                            or straggle (delay) the shard past its
+                            deadline
+    mesh.probe              raise during an evicted-device re-promotion
+                            probe (keeps the device quarantined)
     batch.dispatch          raise at the batch driver's resolve step
     sigcache.sig            poisoned hit on the signature cache
 
@@ -46,6 +52,7 @@ __all__ = [
     "FaultPlan",
     "FaultSpec",
     "FaultInjector",
+    "InjectedDeviceLoss",
     "InjectedFault",
     "InjectedTimeout",
     "active",
@@ -53,6 +60,7 @@ __all__ = [
     "inject",
     "maybe_raise",
     "poison_hit",
+    "shard_delay",
 ]
 
 _FAULTS_FIRED = _obs_counter(
@@ -63,8 +71,10 @@ _FAULTS_FIRED = _obs_counter(
 
 # Corruption kinds vs raise kinds: `corrupt_verdict` consumes the former,
 # `maybe_raise` the latter, so one plan can arm both on one site.
-_RAISE_KINDS = ("raise", "timeout")
+# "straggle" is consumed only by `shard_delay` (per-shard deadline path).
+_RAISE_KINDS = ("raise", "timeout", "device-loss")
 _CORRUPT_KINDS = ("invert", "flip", "value", "nan", "garbage", "shape")
+_STRAGGLE_KINDS = ("straggle",)
 
 
 class InjectedFault(RuntimeError):
@@ -80,11 +90,22 @@ class InjectedTimeout(InjectedFault):
     """Injected dispatch timeout (distinct type: deadline-path tests)."""
 
 
+class InjectedDeviceLoss(InjectedFault):
+    """Injected device loss (distinct type: the mesh settle seam treats
+    it as a per-shard hardware failure feeding the eviction ladder)."""
+
+
 @dataclass
 class FaultSpec:
     """One armed fault: fire `kind` at `site` up to `count` times.
 
     kind: "raise" | "timeout"             -> maybe_raise sites
+          "device-loss"                   -> maybe_raise sites (distinct
+                                             exception type; mesh settle
+                                             feeds it to the shard ladder)
+          "straggle"                      -> shard_delay sites report
+                                             `value` seconds of simulated
+                                             shard lag
           "invert"                        -> logical NOT of the whole buffer
           "flip"                          -> flip `lanes` PRNG-chosen lanes
           "value"                         -> set `lanes` lanes to `value`
@@ -168,7 +189,25 @@ def maybe_raise(site: str) -> None:
         return
     if spec.kind == "timeout":
         raise InjectedTimeout(site, spec.kind)
+    if spec.kind == "device-loss":
+        raise InjectedDeviceLoss(site, spec.kind)
     raise InjectedFault(site, spec.kind)
+
+
+def shard_delay(site: str) -> float:
+    """Shard-settle hook: seconds of simulated lag for this shard.
+
+    Returns 0.0 when disarmed (one module-global read). The mesh settle
+    seam adds the returned value to the shard's observed elapsed time,
+    so an armed "straggle" spec with `value` past the per-shard deadline
+    drives the deadline/redispatch path without real sleeping."""
+    inj = _active
+    if inj is None:
+        return 0.0
+    spec = inj._take(site, _STRAGGLE_KINDS)
+    if spec is None:
+        return 0.0
+    return float(spec.value)
 
 
 def poison_hit(site: str) -> bool:

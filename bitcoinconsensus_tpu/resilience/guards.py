@@ -46,7 +46,7 @@ traced, no kernel jaxpr changes.
 
 from __future__ import annotations
 
-from typing import List, Optional, Tuple
+from typing import List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -63,6 +63,7 @@ __all__ = [
     "check_sentinels",
     "ensure_writable",
     "install_sentinels",
+    "install_sentinels_at",
     "set_cache_audit",
     "validate_verdict",
     "verdict_checksum_host",
@@ -252,13 +253,33 @@ def install_sentinels(
     that must not dispatch sentinel-less copy first via
     ``ensure_writable``.
     """
-    global _rotation
-    fields, want_odd, parity, has_t2, neg1, neg2, valid = args
+    fields = args[0]
     size = int(fields.shape[0])
     room = size - n
     if room <= 0:
         _SENTINEL_SKIPPED.inc(reason="no_pad_room")
         return None
+    k = min(room, len(_sentinel_templates()))
+    return install_sentinels_at(args, list(range(n, n + k)), rotation)
+
+
+def install_sentinels_at(
+    args: Tuple, positions: Sequence[int], rotation: Optional[int] = None
+) -> Optional[SentinelSet]:
+    """Write sentinel lanes at explicit row positions, in place.
+
+    The scatter-layout variant of ``install_sentinels``: the sharded
+    verifier reserves the *last* lane of every device shard rather than
+    a contiguous tail region, so each shard carries its own known-answer
+    lane and a per-shard flip is localized to that shard. Template
+    selection still rotates (one process-wide counter advance per call,
+    templates cycle across `positions`), so consecutive dispatches carry
+    different expected patterns per shard.
+
+    Returns None (counted) when the buffers are not writable.
+    """
+    global _rotation
+    fields, want_odd, parity, has_t2, neg1, neg2, valid = args
     arrs = (fields, want_odd, parity, has_t2, neg1, neg2, valid)
     if not all(getattr(a, "flags", None) is not None and a.flags.writeable
                for a in arrs):
@@ -268,11 +289,9 @@ def install_sentinels(
     if rotation is None:
         rotation = _rotation
         _rotation = (_rotation + 1) % len(templates)
-    k = min(room, len(templates))
-    positions, expected = [], []
-    for i in range(k):
+    out_pos, expected = [], []
+    for i, pos in enumerate(positions):
         raw, w, par, h2, n1, n2, exp = templates[(rotation + i) % len(templates)]
-        pos = n + i
         fields[pos] = np.frombuffer(raw, dtype=np.uint8).reshape(4, 32)
         want_odd[pos] = w
         parity[pos] = par
@@ -280,10 +299,10 @@ def install_sentinels(
         neg1[pos] = n1
         neg2[pos] = n2
         valid[pos] = True
-        positions.append(pos)
+        out_pos.append(int(pos))
         expected.append(exp)
-    _SENTINEL_LANES.inc(k)
-    return SentinelSet(positions, expected)
+    _SENTINEL_LANES.inc(len(out_pos))
+    return SentinelSet(out_pos, expected)
 
 
 def check_sentinels(

@@ -145,11 +145,13 @@ class InflightQueue:
 
     - ``prepare(args, n) -> (args, sset)`` — runs once per ticket at
       dispatch time: copy read-only buffers, install sentinel lanes.
-    - ``launch(args, n, level) -> (result, aux)`` — start the device
-      work; returns unsynchronized arrays plus the in-flight checksum
-      pair (or None). Must not block. Exceptions are captured on the
-      ticket and handled at settle (a launch failure is a settle
-      failure that costs zero wire time).
+    - ``launch(args, n, level, sset) -> (result, aux)`` — start the
+      device work; returns unsynchronized arrays plus the in-flight
+      checksum pair (or None). `sset` is whatever `prepare` returned
+      (sentinel set or the sharded verifier's shard layout), so a launch
+      can route by how the batch was laid out. Must not block.
+      Exceptions are captured on the ticket and handled at settle (a
+      launch failure is a settle failure that costs zero wire time).
     - ``materialize(ticket) -> (ok, needs, all_ok)`` — the settle seam:
       synchronize, run fault hooks, validate, check sentinels and the
       checksum. Raises ``VerdictAnomaly`` (or anything) on a bad buffer.
@@ -161,7 +163,7 @@ class InflightQueue:
         self,
         resilience: DispatchResilience,
         site: str,
-        launch: Callable[[Any, int, str], Tuple[Any, Any]],
+        launch: Callable[[Any, int, str, Any], Tuple[Any, Any]],
         materialize: Callable[[Ticket], Tuple[np.ndarray, Optional[np.ndarray], bool]],
         prepare: Optional[Callable[[Any, int], Tuple[Any, Any]]] = None,
         on_device: Optional[Callable[..., None]] = None,
@@ -214,7 +216,7 @@ class InflightQueue:
             return
         try:
             ticket.result, ticket.aux = self._launch_cb(
-                ticket.args, ticket.n, ticket.level
+                ticket.args, ticket.n, ticket.level, ticket.sset
             )
         except Exception as exc:  # settled as a dispatch failure
             ticket.error = exc

@@ -15,6 +15,14 @@ Swept classes (see resilience/faults.py for the site registry):
                          persistent run that quarantines to host)
     dispatch failure     raise / timeout at `jax_backend.dispatch`
     device drop          raise at `mesh.dispatch` (sharded verifier)
+    shard fault domains  flip / invert / garbage / shape / raise /
+                         timeout / straggle / device-loss at
+                         `mesh.shard.<i>` — swept by `--mesh` over a
+                         forced 8-device mesh; a faulted shard is
+                         convicted alone (its checksum, sentinel, or
+                         straggler deadline), only its lanes re-dispatch,
+                         and a lost device is evicted with verification
+                         continuing over the survivors
     driver failure       raise at `batch.dispatch` (verify_batch)
     cache poisoning      fabricated hit at `sigcache.sig`, caught by
                          audit mode (`resilience.set_cache_audit`)
@@ -35,11 +43,16 @@ sentinel install/check, ladder bookkeeping) must cost < 1% of a small
 instrumented run, the same accounting style as
 tests/test_obs.py::test_no_sink_overhead_under_one_percent.
 
+Single-shard `flip` caught by THAT shard's checksum is the mesh sweep's
+hard pass criterion (`flip_caught_by_checksum`), and the disarmed
+per-shard guard hooks must cost < 1% of a sharded verify.
+
 Usage:
     python scripts/consensus_chaos.py                     # sweep, JSON out
     python scripts/consensus_chaos.py --seed 3            # replay a seed
     python scripts/consensus_chaos.py --seed 0 --check    # CI gate
     python scripts/consensus_chaos.py --report chaos.json # write report
+    python scripts/consensus_chaos.py --mesh --check      # shard-domain sweep
 """
 
 from __future__ import annotations
@@ -150,6 +163,236 @@ def _mesh_trial(checks, oracle, seed):
         "verdict_correct": verdict == bool(oracle.all()),
         "ladder_end": sv._resilience.ladder.current,
     }
+
+
+def _mesh_fd_trial(name, checks, oracle, specs, seed, evict_after=None,
+                   warm=False, sv=None):
+    """One sharded-verifier trial with shard-scoped faults armed.
+
+    `warm` runs a clean pass first so the padded shape is seen and the
+    per-shard straggler deadline is armed (it never fires on
+    first-compile shapes). Returns (row, verifier) so callers can chain
+    continuation batches against the possibly-shrunken mesh. Pass `sv`
+    to reuse a verifier across trials: every fresh instance re-traces
+    the sharded step (minutes of work the XLA cache cannot absorb), so
+    non-eviction trials share one — per-trial metric deltas keep them
+    independent.
+    """
+    from bitcoinconsensus_tpu.parallel import mesh as M
+    from bitcoinconsensus_tpu.resilience import FaultPlan, inject
+
+    if sv is None:
+        sv = M.ShardedSecpVerifier(
+            mesh=M.make_mesh(), evict_after=evict_after
+        )
+    if warm:
+        wres, _ = sv.verify_checks_with_verdict(checks)
+        assert np.array_equal(np.asarray(wres, dtype=bool), oracle)
+    checksum0 = {
+        d: M._MESH_SHARD_FAILURES.value(device=d, reason="checksum")
+        for d in sv._shard_device_ids
+    }
+    with inject(FaultPlan(specs), seed=seed) as inj:
+        res, verdict = sv.verify_checks_with_verdict(checks)
+    out = np.asarray(res, dtype=bool)
+    checksum_convictions = {
+        d: int(M._MESH_SHARD_FAILURES.value(device=d, reason="checksum")
+               - checksum0[d])
+        for d in checksum0
+    }
+    row = {
+        "trial": name,
+        "fired": {f"{s}:{k}": c for (s, k), c in sorted(inj.fired.items())},
+        "fault_fired": inj.total_fired() >= 1 or not specs,
+        "bit_identical": bool(np.array_equal(out, oracle)),
+        "verdict_correct": verdict == bool(oracle.all()),
+        "devices_end": int(sv.mesh.devices.size),
+        "checksum_convictions": {
+            d: c for d, c in checksum_convictions.items() if c
+        },
+    }
+    return row, sv
+
+
+def _mesh_overhead(checks, sv=None):
+    """Disarmed per-shard guard cost as a fraction of one warm sharded
+    verify — the same hook-timing accounting as `_overhead_budget`,
+    pointed at the shard fault-domain entry points."""
+    from bitcoinconsensus_tpu.parallel import mesh as M
+    from bitcoinconsensus_tpu.resilience import degrade as D
+    from bitcoinconsensus_tpu.resilience import faults as F
+    from bitcoinconsensus_tpu.resilience import guards as G
+
+    if sv is None:
+        sv = M.ShardedSecpVerifier(mesh=M.make_mesh())
+
+    def run():
+        sv.verify_checks_with_verdict(checks)
+
+    run()  # warm: compiles excluded from the timing below
+    wall = min(_timed(run) for _ in range(3))
+
+    targets = [
+        (F, "maybe_raise"), (F, "shard_delay"), (F, "corrupt_verdict"),
+        (G, "validate_verdict"), (G, "check_checksum"),
+        (G, "install_sentinels_at"), (G.SentinelSet, "check"),
+        (D.ShardLadder, "report_shard"),
+        (D.ShardLadder, "note_clean_dispatch"),
+    ]
+    spent = {f"{o.__name__}.{n}": 0.0 for o, n in targets}
+    calls = {f"{o.__name__}.{n}": 0 for o, n in targets}
+    saved = [(o, n, getattr(o, n)) for o, n in targets]
+
+    def _timing(key, fn):
+        def wrapper(*a, **kw):
+            t0 = time.perf_counter()
+            try:
+                return fn(*a, **kw)
+            finally:
+                spent[key] += time.perf_counter() - t0
+                calls[key] += 1
+        return wrapper
+
+    try:
+        for o, n, fn in saved:
+            setattr(o, n, _timing(f"{o.__name__}.{n}", fn))
+        run()
+    finally:
+        for o, n, fn in saved:
+            setattr(o, n, fn)
+
+    total = sum(spent.values())
+    return {
+        "wall_s": wall,
+        "resilience_s": total,
+        "ratio": total / wall,
+        "hook_calls": {k: v for k, v in sorted(calls.items()) if v},
+        "budget_ok": total < 0.01 * wall,
+    }
+
+
+def run_mesh_sweep(seed: int) -> dict:
+    """Shard fault-domain sweep over a forced 8-device mesh.
+
+    Every shard-scoped fault class is injected against the sharded
+    verifier; each trial must settle bit-identical to the host oracle.
+    Hard criteria beyond bit-identity: a single-shard flip must be
+    convicted by THAT shard's checksum, a straggler by the per-shard
+    deadline, and a lost device must be evicted with the next batch
+    continuing over the survivors.
+    """
+    from bitcoinconsensus_tpu.crypto.jax_backend import TpuSecpVerifier
+    from bitcoinconsensus_tpu.parallel import mesh as M
+    from bitcoinconsensus_tpu.resilience import FaultSpec
+    from bitcoinconsensus_tpu.resilience.guards import GUARD_ANOMALIES
+
+    checks = _mixed_checks(13)  # 14 lanes -> padded 32 over 8 shards of 4
+    oracle = _host_oracle(TpuSecpVerifier(min_batch=8), checks)
+    # Small enough to ride the 14-row pad of a 7-device survivor mesh.
+    cont = _mixed_checks(6)
+    oracle_c = _host_oracle(TpuSecpVerifier(min_batch=8), cont)
+    trials = []
+
+    # One verifier is shared by every non-eviction trial (a fresh
+    # instance costs a full re-trace of the sharded step; per-trial
+    # metric deltas keep the trials independent). evict_after is set
+    # high so accumulated convictions across trials never shrink the
+    # shared mesh — eviction is exercised by the dedicated trials below
+    # on their own instances.
+    shared = M.ShardedSecpVerifier(mesh=M.make_mesh(), evict_after=100)
+
+    row, _sv = _mesh_fd_trial("mesh-clean", checks, oracle, [], seed,
+                              sv=shared)
+    trials.append(row)
+
+    # Single-shard flip — the HARD criterion: shard 2's own checksum
+    # must convict it (localized: no other device blamed).
+    row, _sv = _mesh_fd_trial(
+        "mesh-shard-flip", checks, oracle,
+        [FaultSpec("mesh.shard.2", "flip")], seed, sv=shared,
+    )
+    row["flip_caught_by_checksum"] = (
+        row["checksum_convictions"].get("2", 0) >= 1
+        and all(d == "2" for d in row["checksum_convictions"])
+    )
+    trials.append(row)
+
+    for kind in ("invert", "garbage", "shape"):
+        row, _sv = _mesh_fd_trial(
+            f"mesh-shard-{kind}", checks, oracle,
+            [FaultSpec("mesh.shard.3", kind)], seed, sv=shared,
+        )
+        trials.append(row)
+    for kind in ("raise", "timeout"):
+        row, _sv = _mesh_fd_trial(
+            f"mesh-shard-{kind}", checks, oracle,
+            [FaultSpec("mesh.shard.1", kind)], seed, sv=shared,
+        )
+        trials.append(row)
+
+    # Straggler: needs a warm (seen-shape) dispatch so the per-shard
+    # deadline is armed; the slow shard is convicted without waiting.
+    dl0 = GUARD_ANOMALIES.value(site="mesh.shard.0", reason="deadline")
+    row, _sv = _mesh_fd_trial(
+        "mesh-shard-straggle", checks, oracle,
+        [FaultSpec("mesh.shard.0", "straggle", value=9e9)], seed, warm=True,
+        sv=shared,
+    )
+    row["deadline_convicted"] = (
+        GUARD_ANOMALIES.value(site="mesh.shard.0", reason="deadline")
+        == dl0 + 1
+    )
+    trials.append(row)
+
+    # Device loss with evict_after=1: the device leaves the mesh, the
+    # step re-jits over the survivors, and the NEXT batch still flows.
+    row, sv = _mesh_fd_trial(
+        "mesh-device-loss-evict", checks, oracle,
+        [FaultSpec("mesh.shard.1", "device-loss")], seed, evict_after=1,
+    )
+    row["eviction_happened"] = (
+        row["devices_end"] == 7 and "1" not in sv._shard_device_ids
+    )
+    res_c, verdict_c = sv.verify_checks_with_verdict(cont)
+    row["continued_bit_identical"] = bool(
+        np.array_equal(np.asarray(res_c, dtype=bool), oracle_c)
+    ) and verdict_c == bool(oracle_c.all())
+    trials.append(row)
+
+    # Re-promotion: a clean known-answer probe (REAL kernel, pinned to
+    # the evicted device) re-admits it and the mesh grows back to 8.
+    row, sv = _mesh_fd_trial(
+        "mesh-repromote", checks, oracle,
+        [FaultSpec("mesh.shard.1", "device-loss")], seed, evict_after=1,
+    )
+    sv._shard_ladder.reprobe_after = 1
+    res_c, _ = sv.verify_checks_with_verdict(cont)
+    row["bit_identical"] = row["bit_identical"] and bool(
+        np.array_equal(np.asarray(res_c, dtype=bool), oracle_c)
+    )
+    row["repromoted"] = int(sv.mesh.devices.size) == 8
+    trials.append(row)
+
+    # Whole-mesh faults: dispatch raise (in-flight retry path) and a
+    # two-shard fault in one dispatch (both convicted independently).
+    row, _sv = _mesh_fd_trial(
+        "mesh-multi-shard", checks, oracle,
+        [FaultSpec("mesh.shard.1", "flip"),
+         FaultSpec("mesh.shard.4", "garbage")], seed, sv=shared,
+    )
+    trials.append(row)
+    # Last shard-level trial on the shared verifier: a whole-dispatch
+    # raise can cost the mesh rung a demotion strike, which must not
+    # starve a later trial's shard-settle probes.
+    row, _sv = _mesh_fd_trial(
+        "mesh-dispatch-raise", checks, oracle,
+        [FaultSpec("mesh.dispatch", "raise")], seed, sv=shared,
+    )
+    trials.append(row)
+
+    overhead = _mesh_overhead(checks, sv=shared)
+    return {"seed": seed, "mesh": True, "trials": trials,
+            "overhead": overhead}
 
 
 def _batch_items(funded, bad_first=False):
@@ -380,7 +623,10 @@ def _problems(report: dict) -> list:
             probs.append(f"{t['trial']}: verdicts differ from host oracle")
         if t["trial"] != "clean" and not t["fault_fired"]:
             probs.append(f"{t['trial']}: armed fault never fired (dead site?)")
-        for key in ("verdict_correct", "quarantined_to_host"):
+        for key in ("verdict_correct", "quarantined_to_host",
+                    "flip_caught_by_checksum", "deadline_convicted",
+                    "eviction_happened", "continued_bit_identical",
+                    "repromoted"):
             if t.get(key) is False:
                 probs.append(f"{t['trial']}: {key} is False")
     ov = report["overhead"]
@@ -401,9 +647,12 @@ def main(argv=None) -> int:
                     "bit-identically and the overhead budget holds")
     ap.add_argument("--report", metavar="PATH",
                     help="write the JSON report to this path")
+    ap.add_argument("--mesh", action="store_true",
+                    help="run the shard fault-domain sweep over a forced "
+                    "8-device mesh instead of the single-device sweep")
     args = ap.parse_args(argv)
 
-    report = run_sweep(args.seed)
+    report = run_mesh_sweep(args.seed) if args.mesh else run_sweep(args.seed)
     doc = json.dumps(report, indent=2)
     if args.report:
         with open(args.report, "w", encoding="utf-8") as fh:

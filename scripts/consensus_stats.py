@@ -64,10 +64,15 @@ REQUIRED_METRICS = [
     "consensus_dispatch_padded_lanes_total",
     "consensus_dispatch_fill_ratio",
     "consensus_dispatch_new_shapes_total",
-    # mesh
+    # mesh (fault-domain counters light up via the workload's eviction
+    # leg; consensus_mesh_repromotions_total is chaos-sweep-only)
     "consensus_mesh_devices",
     "consensus_mesh_dispatch_total",
     "consensus_mesh_shard_lanes",
+    "consensus_mesh_healthy_devices",
+    "consensus_mesh_shard_failures_total",
+    "consensus_mesh_evictions_total",
+    "consensus_mesh_redispatch_lanes_total",
     # block connect
     "consensus_blocks_total",
     "consensus_block_reject_total",
@@ -172,6 +177,19 @@ def run_mini_workload() -> None:
     checks = [SigCheck("ecdsa", (w.pub, sig, msg))] * 4
     res, verdict = sv.verify_checks_with_verdict(checks)
     assert verdict and res.all()
+
+    # --- mesh fault domains: one injected device loss evicts a device
+    # and re-answers its lanes, lighting the shard-failure / eviction /
+    # re-dispatch counters on their real code paths ---
+    from bitcoinconsensus_tpu.resilience import FaultPlan, FaultSpec, inject
+
+    sv2 = ShardedSecpVerifier(mesh=make_mesh(), evict_after=1)
+    with inject(
+        FaultPlan([FaultSpec("mesh.shard.1", "device-loss")]), seed=0
+    ):
+        res2, verdict2 = sv2.verify_checks_with_verdict(checks)
+    assert verdict2 and res2.all()
+    assert int(sv2.mesh.devices.size) == 7  # survivor mesh kept flowing
 
 
 def main(argv=None) -> int:

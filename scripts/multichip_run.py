@@ -1,0 +1,166 @@
+#!/usr/bin/env python
+"""Measured multi-chip sharded verification run (MULTICHIP_rNN producer).
+
+Unlike `__graft_entry__.dryrun_multichip` (a structural dry run of the
+sharded step), this drives a REAL measured workload through
+`ShardedSecpVerifier` on a forced n-device mesh and records the result
+as a JSON document:
+
+1. **clean**: a mixed batch dispatched over all n devices, timed over
+   several warm iterations (lanes/s), verdicts compared bit-for-bit
+   against the host-exact oracle;
+2. **eviction-and-continue**: an injected device loss (`mesh.shard.1`,
+   `evict_after=1`) must evict that device, rebuild the mesh over the
+   survivors, re-answer the lost shard's lanes bit-identically, and the
+   NEXT batch must flow through the shrunken mesh.
+
+No real multi-chip hardware is assumed: the run pins a virtual n-device
+CPU platform (same forcing as tests/conftest.py, so the persistent XLA
+compile cache is shared). On a TPU pod slice the same script measures
+the real thing — drop the forcing with --no-force.
+
+Usage:
+    python scripts/multichip_run.py --out MULTICHIP_r06.json
+    python scripts/multichip_run.py --devices 8 --iters 5
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _pin(n_devices: int, force: bool) -> None:
+    if not force:
+        return
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={n_devices}"
+        ).strip()
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--devices", type=int, default=8)
+    ap.add_argument("--iters", type=int, default=5,
+                    help="timed iterations after warmup (default: 5)")
+    ap.add_argument("--out", metavar="PATH",
+                    help="write the JSON document to this path")
+    ap.add_argument("--no-force", action="store_true",
+                    help="use the ambient platform instead of forcing a "
+                    "virtual CPU mesh (real multi-chip hardware)")
+    args = ap.parse_args(argv)
+
+    _pin(args.devices, not args.no_force)
+    import jax
+
+    if not args.no_force:
+        jax.config.update("jax_platforms", "cpu")
+    import numpy as np  # noqa: E402
+
+    import __graft_entry__ as ge
+    from bitcoinconsensus_tpu.crypto.jax_backend import TpuSecpVerifier
+    from bitcoinconsensus_tpu.parallel import mesh as M
+    from bitcoinconsensus_tpu.resilience import FaultPlan, FaultSpec, inject
+
+    devs = jax.devices()
+    assert len(devs) >= args.devices, (
+        f"need {args.devices} devices, have {len(devs)}x {devs[0].platform}"
+    )
+
+    # Mixed kinds (ECDSA / Schnorr / taproot tweak), all valid; 13 lanes
+    # pad to 32 rows over 8 shards of 4 (3 real lanes + sentinel on the
+    # busy shards), so the eviction trial re-dispatches a 3-lane shard.
+    checks = ge._example_checks(13)
+    oracle = np.asarray(
+        [TpuSecpVerifier(min_batch=8)._host_check(c) for c in checks],
+        dtype=bool,
+    )
+    assert oracle.all(), "workload checks must all be valid"
+
+    # --- clean measured run -------------------------------------------
+    sv = M.ShardedSecpVerifier(mesh=M.make_mesh(args.devices))
+    disp0 = M._MESH_DISPATCH.value()
+    res, verdict = sv.verify_checks_with_verdict(checks)  # warm/compile
+    assert np.array_equal(np.asarray(res, dtype=bool), oracle) and verdict
+    walls = []
+    for _ in range(args.iters):
+        t0 = time.perf_counter()
+        res, verdict = sv.verify_checks_with_verdict(checks)
+        walls.append(time.perf_counter() - t0)
+        assert np.array_equal(np.asarray(res, dtype=bool), oracle) and verdict
+    best = min(walls)
+    clean = {
+        "lanes": len(checks),
+        "iters": args.iters,
+        "wall_s": [round(w, 6) for w in walls],
+        "best_s": round(best, 6),
+        "lanes_per_s": round(len(checks) / best, 1),
+        "bit_identical": True,
+        "verdict": bool(verdict),
+        "mesh_dispatches": int(M._MESH_DISPATCH.value() - disp0),
+    }
+
+    # --- eviction-and-continue trial ----------------------------------
+    sv2 = M.ShardedSecpVerifier(mesh=M.make_mesh(args.devices), evict_after=1)
+    lost = sv2._shard_device_ids[1]
+    ev0 = M._MESH_EVICTIONS.value(device=lost)
+    with inject(
+        FaultPlan([FaultSpec("mesh.shard.1", "device-loss")]), seed=0
+    ) as inj:
+        res, verdict = sv2.verify_checks_with_verdict(checks)
+    assert inj.total_fired() >= 1, "device-loss fault never fired"
+    assert np.array_equal(np.asarray(res, dtype=bool), oracle) and verdict
+    assert M._MESH_EVICTIONS.value(device=lost) == ev0 + 1
+    survivors = int(sv2.mesh.devices.size)
+    assert survivors == args.devices - 1 and lost not in sv2._shard_device_ids
+    cont = ge._example_checks(6)
+    oracle_c = np.asarray(
+        [TpuSecpVerifier(min_batch=8)._host_check(c) for c in cont],
+        dtype=bool,
+    )
+    res_c, verdict_c = sv2.verify_checks_with_verdict(cont)
+    cont_ok = bool(
+        np.array_equal(np.asarray(res_c, dtype=bool), oracle_c) and verdict_c
+    )
+    assert cont_ok
+    eviction = {
+        "evicted_device": lost,
+        "devices_after": survivors,
+        "bit_identical": True,
+        "continued_lanes": len(cont),
+        "continued_bit_identical": cont_ok,
+    }
+
+    doc = {
+        "n_devices": args.devices,
+        "platform": devs[0].platform,
+        "forced_virtual_mesh": not args.no_force,
+        "dry_run": False,
+        "ok": True,
+        "clean": clean,
+        "eviction": eviction,
+    }
+    out = json.dumps(doc, indent=2)
+    if args.out:
+        with open(args.out, "w", encoding="utf-8") as fh:
+            fh.write(out + "\n")
+    print(out)
+    print(
+        f"# multichip run OK: {args.devices} devices, "
+        f"{clean['lanes_per_s']} lanes/s best, eviction continued on "
+        f"{survivors} devices",
+        file=sys.stderr,
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

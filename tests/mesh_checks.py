@@ -120,11 +120,88 @@ def check_hostreject() -> None:
     assert not all_ok
 
 
+def check_faultdomains() -> None:
+    """Shard fault domains on the REAL kernels: a single-shard verdict
+    flip is convicted by THAT shard's checksum and only its lanes
+    re-dispatch; a device loss evicts the device, the mesh rebuilds over
+    the 7 survivors, and verification continues bit-identically."""
+    from bitcoinconsensus_tpu.crypto import secp_host as H
+    from bitcoinconsensus_tpu.crypto.jax_backend import SigCheck, TpuSecpVerifier
+    from bitcoinconsensus_tpu.parallel import mesh as M
+    from bitcoinconsensus_tpu.resilience import guards as G
+    from bitcoinconsensus_tpu.resilience.faults import FaultPlan, FaultSpec, inject
+
+    def mk(n, tag):
+        out = []
+        for i in range(n):
+            sk = (i * 6700417 + 29) % (H.N - 1) + 1
+            msg = hashlib.sha256(b"fd-%s-%d" % (tag, i)).digest()
+            out.append(
+                SigCheck("ecdsa", (H.pubkey_create(sk), H.sign_ecdsa(sk, msg), msg))
+            )
+        return out
+
+    checks = mk(8, b"a")
+    oracle = TpuSecpVerifier().verify_checks(checks)
+    assert oracle.all()
+
+    # 1) Clean sharded run (warms the 16-lane 8-device step).
+    v = M.ShardedSecpVerifier(M.make_mesh(8))
+    res, ok = v.verify_checks_with_verdict(checks)
+    assert np.array_equal(res, oracle) and ok
+
+    # 2) Single-shard flip: the per-shard checksum convicts shard 2 alone
+    #    and only its (one) lane re-dispatches over the surviving mesh.
+    flips0 = M._MESH_SHARD_FAILURES.value(device="2", reason="checksum")
+    redisp0 = M._MESH_REDISPATCH_LANES.value(level="mesh")
+    with inject(FaultPlan([FaultSpec("mesh.shard.2", "flip")])) as inj:
+        res, ok = v.verify_checks_with_verdict(checks)
+    assert inj.total_fired() >= 1
+    assert np.array_equal(res, oracle) and ok
+    assert M._MESH_SHARD_FAILURES.value(
+        device="2", reason="checksum"
+    ) == flips0 + 1
+    assert M._MESH_REDISPATCH_LANES.value(level="mesh") == redisp0 + 1
+
+    # 3) Straggler: the per-shard deadline (armed — shape seen) convicts
+    #    the slow shard without waiting; verdicts stay bit-identical.
+    dl0 = G.GUARD_ANOMALIES.value(site="mesh.shard.0", reason="deadline")
+    with inject(
+        FaultPlan([FaultSpec("mesh.shard.0", "straggle", value=9e9)])
+    ) as inj:
+        res, ok = v.verify_checks_with_verdict(checks)
+    assert inj.total_fired() >= 1
+    assert np.array_equal(res, oracle) and ok
+    assert G.GUARD_ANOMALIES.value(
+        site="mesh.shard.0", reason="deadline"
+    ) == dl0 + 1
+
+    # 4) Device loss with evict_after=1: device 1 leaves the mesh, the
+    #    step re-jits over 7 survivors, and the NEXT batch still flows.
+    v2 = M.ShardedSecpVerifier(M.make_mesh(8), evict_after=1)
+    ev0 = M._MESH_EVICTIONS.value(device="1")
+    with inject(
+        FaultPlan([FaultSpec("mesh.shard.1", "device-loss")])
+    ) as inj:
+        res, ok = v2.verify_checks_with_verdict(checks)
+    assert inj.total_fired() >= 1
+    assert np.array_equal(res, oracle) and ok
+    assert M._MESH_EVICTIONS.value(device="1") == ev0 + 1
+    assert int(v2.mesh.devices.size) == 7 and "1" not in v2._shard_device_ids
+    cont = mk(7, b"b")
+    oracle7 = TpuSecpVerifier().verify_checks(cont)
+    res7, ok7 = v2.verify_checks_with_verdict(cont)
+    assert np.array_equal(res7, oracle7) and ok7
+    print("faultdomains: flip contained, straggler convicted, "
+          "eviction continued on 7 devices")
+
+
 CHECKS = {
     "dryrun": check_dryrun,
     "sharded": check_sharded,
     "np2": check_np2,
     "hostreject": check_hostreject,
+    "faultdomains": check_faultdomains,
 }
 
 if __name__ == "__main__":

@@ -38,7 +38,7 @@ class _Backend:
         self.launch_fails = launch_fails
         self.settle_fails = settle_fails
 
-    def launch(self, args, n, level):
+    def launch(self, args, n, level, sset=None):
         self.launches.append((n, level))
         if self.launch_fails > 0:
             self.launch_fails -= 1
