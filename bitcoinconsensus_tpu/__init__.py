@@ -12,6 +12,7 @@ Layout (see SURVEY.md for the reference layer map this covers):
 - ``models``   — verification pipelines (single verify, deferred batch,
                  block replay)
 - ``parallel`` — mesh sharding of batches over devices
+- ``serving``  — overload-safe front end: coalescing, admission, shedding
 - ``utils``    — hashing, helpers
 """
 

@@ -240,11 +240,13 @@ def lint_consensus_host(repo_root: str) -> List[LintFinding]:
     pkg = os.path.join(repo_root, "bitcoinconsensus_tpu")
     findings = lint_paths([os.path.join(pkg, "core"),
                            os.path.join(pkg, "models")])
-    # resilience/ is host-side policy with wall-clock deadlines: like
-    # crypto/ it may use floats but must read time through obs.monotonic,
-    # never raw time.* pairs the telemetry cannot see.
+    # resilience/ and serving/ are host-side policy with wall-clock
+    # deadlines: like crypto/ they may use floats but must read time
+    # through obs.monotonic, never raw time.* pairs the telemetry
+    # cannot see (sleeping is fine; reading a clock is not).
     findings += lint_paths([os.path.join(pkg, "crypto"),
-                           os.path.join(pkg, "resilience")],
+                           os.path.join(pkg, "resilience"),
+                           os.path.join(pkg, "serving")],
                           rules=TIMING_RULES)
     findings += lint_paths([os.path.join(pkg, "ops", "pallas_kernel.py")],
                            rules=PALLAS_RULES)

@@ -558,6 +558,27 @@ class IdxFixpoint:
                 still.append(idx)
         self._pending = still
 
+    def abandon(self) -> None:
+        """Settle-and-discard the in-flight round without running the
+        fixpoint (the stream driver's generator-close path). The round's
+        device tickets hold buffers and a backpressure slot in the
+        verifier's in-flight queue, so they must settle even when nobody
+        wants the verdicts; settle failures are already contained by the
+        guards and irrelevant to a dead run."""
+        if self._in_flight is None:
+            self._pending = []
+            return
+        _interp, rec = self._in_flight
+        self._in_flight = None
+        if rec is not None:
+            _grow, _keys, pending = rec
+            for pend, sub in pending:
+                try:
+                    self.verifier.sync_lanes(pend, len(sub))
+                except Exception:
+                    pass
+        self._pending = []
+
     def finish(self) -> Dict[int, Tuple[bool, int]]:
         """Settle the in-flight round, then loop to the fixpoint."""
         if self._in_flight is not None:
@@ -766,12 +787,27 @@ def verify_batch_stream(
         _record_batch_results(out)
         return out
 
-    for items in batches:
-        window.append(_begin(items))
-        while len(window) >= depth:
+    try:
+        for items in batches:
+            window.append(_begin(items))
+            while len(window) >= depth:
+                yield _finish(window.pop(0))
+        while window:
             yield _finish(window.pop(0))
+    finally:
+        # Consumer closed the generator mid-stream (GeneratorExit lands
+        # at a yield above): begun batches still hold in-flight device
+        # tickets — settle and discard them so buffers and backpressure
+        # slots in the verifier's queue are not leaked.
+        _abandon_stream_window(window)
+
+
+def _abandon_stream_window(window: List[tuple]) -> None:
+    """Settle-and-discard every begun-but-unfinished stream handle."""
     while window:
-        yield _finish(window.pop(0))
+        handle = window.pop(0)
+        if handle[0] == "idx" and handle[1] is not None:
+            handle[1].abandon()
 
 
 def _prepare_and_probe(

@@ -159,6 +159,11 @@ class _SaltedLRU:
 
     def add_key(self, k: bytes) -> None:
         with self._lock:
+            # A re-add of a present key is a freshness touch, not an
+            # insertion: counting it would break the entry-accounting
+            # invariant (insertions - evictions - erases == entries)
+            # that concurrent writers rely on to detect lost entries.
+            new = k not in self._set
             self._set[k] = None
             self._set.move_to_end(k)
             evicted = 0
@@ -166,9 +171,11 @@ class _SaltedLRU:
                 self._set.popitem(last=False)
                 evicted += 1
             self.evictions += evicted
-            self.insertions += 1
+            if new:
+                self.insertions += 1
             size = len(self._set)
-        self._m_inserts.inc()
+        if new:
+            self._m_inserts.inc()
         if evicted:
             self._m_evicts.inc(evicted)
         self._m_entries.set(size)

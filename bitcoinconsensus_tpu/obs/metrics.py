@@ -214,6 +214,32 @@ class Histogram(_Metric):
             cell[1] += value
             cell[2] += 1
 
+    def quantile(self, q: float, **labels) -> Optional[float]:
+        """Conservative quantile estimate from the fixed buckets.
+
+        Returns the upper bound (``le``) of the first bucket whose
+        cumulative count reaches ``q * count`` — an over-estimate, which
+        is the safe direction for the admission control built on it
+        (serving/shedding.py). Returns None with no observations and
+        ``math.inf`` when the quantile lands in the implicit +Inf
+        overflow bucket.
+        """
+        if not 0.0 < q <= 1.0:
+            raise ValueError("q must be in (0, 1]")
+        key = self._key(labels)
+        with self._lock:
+            cell = self._values.get(key)
+            if cell is None or cell[2] == 0:
+                return None
+            counts, count = list(cell[0]), cell[2]
+        rank = q * count
+        cum = 0
+        for i, c in enumerate(counts[:-1]):
+            cum += c
+            if cum >= rank:
+                return self.buckets[i]
+        return math.inf
+
     def _samples(self) -> List[dict]:
         with self._lock:
             items = [

@@ -1,0 +1,52 @@
+"""Overload-safe serving front end on the resilience substrate.
+
+PRs 5-7 made *faults* fail closed (guards, ladder, in-flight tickets,
+shard domains); this package makes *overload* fail closed too. The
+pieces, bottom up:
+
+- `queue.CoalescingQueue` — per-tenant bounded FIFOs drained
+  round-robin into `lane_capacity`-sized device batches (time-or-size
+  flush); a full tenant slice rejects at put time.
+- `shedding.SloTracker` / `shedding.AdmissionController` — p50/p99
+  settle-latency gauges derived from `obs/` histogram buckets drive a
+  queueing-estimate admission check; a quarantined dispatch ladder
+  shrinks the deadline budget, so a sick mesh sheds earlier.
+- `server.VerifyServer` — the context-managed front end: submit() →
+  admit-or-`OverloadError`, one worker thread drives bursts through
+  `models/batch.verify_batch_stream`, close() drains (or explicitly
+  cancels) everything admitted and leaves no unsettled ticket.
+- `client.verify_with_retry` — bounded retries with jittered
+  exponential backoff for shed requests.
+
+Chaos-gated by `scripts/consensus_chaos.py --serve`: concurrent
+clients against injected faults plus synthetic overload, requiring
+bit-identical verdicts for every admitted request and an explicit
+reject for every shed one. `scripts/consensus_stats.py` snapshots the
+`consensus_serving_*` metrics; README "Serving" documents the knobs.
+"""
+
+from .client import verify_with_retry
+from .queue import CoalescingQueue, QueueClosed, TenantQueueFull
+from .server import OverloadError, PendingVerify, VerifyServer
+from .shedding import (
+    SHED_CLOSED,
+    SHED_SLO,
+    SHED_TENANT_FULL,
+    AdmissionController,
+    SloTracker,
+)
+
+__all__ = [
+    "AdmissionController",
+    "CoalescingQueue",
+    "OverloadError",
+    "PendingVerify",
+    "QueueClosed",
+    "SloTracker",
+    "TenantQueueFull",
+    "VerifyServer",
+    "verify_with_retry",
+    "SHED_CLOSED",
+    "SHED_SLO",
+    "SHED_TENANT_FULL",
+]

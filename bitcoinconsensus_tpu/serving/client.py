@@ -1,0 +1,59 @@
+"""Bounded-retry client path for shed requests.
+
+A shed (`OverloadError`) is a fail-closed reject of work that never
+started, so retrying is always safe — but unbounded synchronized
+retries would just re-create the overload (the classic thundering
+herd). `verify_with_retry` therefore backs off exponentially with
+full jitter (a uniform fraction of the current delay, so colliding
+clients decorrelate) and gives up after a bounded number of attempts,
+re-raising the final `OverloadError` for the caller to surface.
+
+`time.sleep` is the only time-API use here (sleeping, not reading a
+clock — the host-lint timing rule distinguishes the two); the RNG is
+injectable so tests and the chaos sweep stay deterministic.
+"""
+
+from __future__ import annotations
+
+import random
+import time
+from typing import Optional
+
+from ..models.batch import BatchItem, BatchResult
+from .server import OverloadError, VerifyServer
+
+__all__ = ["verify_with_retry"]
+
+
+def verify_with_retry(
+    server: VerifyServer,
+    item: BatchItem,
+    tenant: str = "default",
+    retries: int = 4,
+    backoff_s: float = 0.01,
+    max_backoff_s: float = 0.25,
+    timeout_s: Optional[float] = 60.0,
+    rng: Optional[random.Random] = None,
+) -> BatchResult:
+    """Submit with up to `retries` re-attempts after sheds.
+
+    Returns the settled `BatchResult`; re-raises the last
+    `OverloadError` once the retry budget is spent. Batch-driver
+    failures and settle timeouts propagate immediately — only explicit
+    sheds are retried.
+    """
+    if rng is None:
+        rng = random.Random()
+    delay = backoff_s
+    attempt = 0
+    while True:
+        try:
+            pending = server.submit(item, tenant)
+        except OverloadError:
+            if attempt >= retries:
+                raise
+            attempt += 1
+            time.sleep(delay * (0.5 + rng.random()))  # jitter [0.5x, 1.5x)
+            delay = min(delay * 2, max_backoff_s)
+            continue
+        return pending.result(timeout_s)

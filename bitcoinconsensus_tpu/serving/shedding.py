@@ -1,0 +1,125 @@
+"""SLO-driven load shedding: settle-latency quantiles → admission.
+
+The shed decision is a queueing estimate, not a vibe: the server
+observes every coalesced batch's settle latency into an `obs/`
+histogram; `SloTracker` derives p50/p99 from the cumulative bucket
+counts (`Histogram.quantile` — a conservative upper estimate) and
+publishes them as gauges. `AdmissionController` then asks, for each
+arriving request: *if admitted, how long until its batch settles?* —
+`ceil((queued + 1) / batch_capacity)` batches ahead, each costing ~p99.
+When that projected wait exceeds the deadline budget, the request is
+shed with an explicit `Error.ERR_OVERLOADED` (fail-closed reject, never
+a hang; the bounded-retry client in serving/client.py is the recovery
+path).
+
+Ladder coupling (resilience/degrade.py): a quarantined mesh is already
+running on a slower rung and burning retry budget, so it sheds earlier —
+the deadline budget is divided by ``1 + rung``. Demotion to xla halves
+the budget, the host rung cuts it to a third, and re-promotion restores
+it automatically; no separate shed state machine to thrash.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ..obs import gauge as _obs_gauge
+from ..obs import histogram as _obs_histogram
+
+__all__ = [
+    "AdmissionController",
+    "SloTracker",
+    "SHED_CLOSED",
+    "SHED_SLO",
+    "SHED_TENANT_FULL",
+]
+
+# Shed reasons (the `reason` label on consensus_serving_shed_total).
+SHED_CLOSED = "closed"            # server draining / shut down
+SHED_TENANT_FULL = "tenant_full"  # bounded per-tenant queue depth hit
+SHED_SLO = "slo"                  # projected queue wait blows the deadline
+
+# Batch settle latencies: 1 ms (warm cached replay) .. 10 s (cold
+# compile over the tunnel). Finer-grained than the generic span buckets
+# because the quantile estimate is only as sharp as the bucket edges.
+_BATCH_LATENCY_BUCKETS = (
+    0.001, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
+)
+
+_BATCH_SECONDS = _obs_histogram(
+    "consensus_serving_batch_seconds",
+    "coalesced batch settle latency (flush to verdict delivery)",
+    buckets=_BATCH_LATENCY_BUCKETS,
+)
+_SLO_GAUGE = _obs_gauge(
+    "consensus_serving_slo_seconds",
+    "batch settle-latency quantile estimates driving admission",
+    ("q",),
+)
+
+
+class SloTracker:
+    """Settle-latency histogram + derived p50/p99 gauges."""
+
+    def __init__(self, histogram=None):
+        self._hist = histogram if histogram is not None else _BATCH_SECONDS
+        self._p50 = _SLO_GAUGE.labels(q="p50")
+        self._p99 = _SLO_GAUGE.labels(q="p99")
+
+    def observe(self, seconds: float) -> None:
+        self._hist.observe(seconds)
+        p50, p99 = self._hist.quantile(0.5), self._hist.quantile(0.99)
+        if p50 is not None:
+            self._p50.set(p50)
+        if p99 is not None:
+            self._p99.set(p99)
+
+    def quantile(self, q: float) -> Optional[float]:
+        return self._hist.quantile(q)
+
+
+class AdmissionController:
+    """Reject work whose projected queue wait blows the SLO deadline."""
+
+    def __init__(
+        self,
+        slo_deadline_s: float,
+        batch_capacity: int,
+        slo: SloTracker,
+        ladder=None,
+    ):
+        if slo_deadline_s <= 0:
+            raise ValueError("slo_deadline_s must be > 0")
+        if batch_capacity < 1:
+            raise ValueError("batch_capacity must be >= 1")
+        self.slo_deadline_s = slo_deadline_s
+        self.batch_capacity = batch_capacity
+        self.slo = slo
+        self._ladder = ladder
+
+    def ladder_rung(self) -> int:
+        """0 at full health; grows as the dispatch ladder quarantines."""
+        if self._ladder is None:
+            return 0
+        try:
+            return self._ladder.levels.index(self._ladder.current)
+        except ValueError:  # defensive: unknown level reads as healthy
+            return 0
+
+    def deadline_budget_s(self) -> float:
+        return self.slo_deadline_s / (1 + self.ladder_rung())
+
+    def admit(self, queued_total: int) -> Optional[str]:
+        """None to admit, else the shed reason.
+
+        Cold start (no settled batches yet) always admits — there is no
+        latency evidence to shed on, and the per-tenant depth bound in
+        the queue still caps the damage a thundering herd can do.
+        """
+        p99 = self.slo.quantile(0.99)
+        if p99 is None:
+            return None
+        batches_ahead = queued_total // self.batch_capacity + 1
+        if batches_ahead * p99 > self.deadline_budget_s():
+            return SHED_SLO
+        return None

@@ -47,12 +47,23 @@ Single-shard `flip` caught by THAT shard's checksum is the mesh sweep's
 hard pass criterion (`flip_caught_by_checksum`), and the disarmed
 per-shard guard hooks must cost < 1% of a sharded verify.
 
+`--serve` sweeps the serving front end (bitcoinconsensus_tpu/serving):
+N concurrent client threads against a live `VerifyServer` under
+injected driver faults AND synthetic overload (bounded tenant queues +
+slow flush). Hard criteria: every admitted request settles
+bit-identical to the host oracle, every shed request gets an explicit
+`ERR_OVERLOADED` (zero hangs, zero silent drops), graceful drain
+leaves no unsettled tickets, the SLO admission unit sheds deep queues
+and sheds earlier under ladder quarantine, and the disarmed serving
+hooks cost < 1% of the served workload.
+
 Usage:
     python scripts/consensus_chaos.py                     # sweep, JSON out
     python scripts/consensus_chaos.py --seed 3            # replay a seed
     python scripts/consensus_chaos.py --seed 0 --check    # CI gate
     python scripts/consensus_chaos.py --report chaos.json # write report
     python scripts/consensus_chaos.py --mesh --check      # shard-domain sweep
+    python scripts/consensus_chaos.py --serve --check     # serving sweep
 """
 
 from __future__ import annotations
@@ -616,24 +627,304 @@ def run_sweep(seed: int) -> dict:
     return {"seed": seed, "trials": trials, "overhead": overhead}
 
 
+def _serve_items_and_oracle():
+    """Serving workload: one single-input item per funded output, the
+    first cryptographically false, plus its fresh-cache host oracle."""
+    from bitcoinconsensus_tpu.models.batch import verify_batch
+    from bitcoinconsensus_tpu.utils import blockgen
+
+    _view, funded = blockgen.make_funded_view(12, seed="serve")
+    items = _batch_items(funded, bad_first=True)
+    sig_cache, script_cache = _fresh_caches()
+    oracle = [
+        r.ok for r in verify_batch(
+            items, sig_cache=sig_cache, script_cache=script_cache)
+    ]
+    assert not oracle[0] and all(oracle[1:]), oracle
+    return items, oracle
+
+
+def _serve_trial(name, items, oracle, specs, seed, server_kw,
+                 n_threads=4, retries=0, expect_sheds=False):
+    """N concurrent client threads (one tenant each) against a live
+    `VerifyServer`, optionally with an armed fault plan and/or synthetic
+    overload (tiny tenant_depth + slow flush in `server_kw`).
+
+    Every request must end in exactly one explicit outcome: a settled
+    verdict (compared bit-for-bit against the host oracle), or an
+    `OverloadError` shed. Anything else — a hang, an unexplained
+    exception, an unsettled future — fails the trial.
+    """
+    import random
+    import threading
+
+    from bitcoinconsensus_tpu.resilience import FaultPlan, inject
+    from bitcoinconsensus_tpu.serving import OverloadError, VerifyServer
+    from bitcoinconsensus_tpu.serving.client import verify_with_retry
+
+    sig_cache, script_cache = _fresh_caches()
+    outcomes = [None] * len(items)
+
+    def client(tid, server):
+        rng = random.Random(seed * 1009 + tid)
+        mine = list(range(tid, len(items), n_threads))
+        pend = []
+        for i in mine:
+            try:
+                if retries:
+                    res = verify_with_retry(
+                        server, items[i], tenant=f"t{tid}",
+                        retries=retries, backoff_s=0.02,
+                        max_backoff_s=0.3, timeout_s=120, rng=rng,
+                    )
+                    outcomes[i] = ("ok", res.ok)
+                else:
+                    pend.append((i, server.submit(items[i], f"t{tid}")))
+            except OverloadError as e:
+                outcomes[i] = ("shed", e.reason)
+            except Exception as e:  # anything else is a trial failure
+                outcomes[i] = ("error", repr(e))
+        for i, p in pend:
+            try:
+                outcomes[i] = ("ok", p.result(timeout=120).ok)
+            except Exception as e:
+                outcomes[i] = ("error", repr(e))
+
+    with inject(FaultPlan(specs), seed=seed) as inj:
+        server = VerifyServer(
+            sig_cache=sig_cache, script_cache=script_cache, **server_kw
+        ).start()
+        threads = [
+            threading.Thread(target=client, args=(t, server))
+            for t in range(n_threads)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(180)
+        hung = any(t.is_alive() for t in threads)
+        server.close(drain=True)
+
+    admitted = [i for i, o in enumerate(outcomes) if o and o[0] == "ok"]
+    sheds = [i for i, o in enumerate(outcomes) if o and o[0] == "shed"]
+    errors = [
+        i for i, o in enumerate(outcomes) if o is None or o[0] == "error"
+    ]
+    row = {
+        "trial": name,
+        "fired": {f"{s}:{k}": c for (s, k), c in sorted(inj.fired.items())},
+        "admitted": len(admitted),
+        "shed": len(sheds),
+        "errors": len(errors),
+        "bit_identical": bool(admitted) and all(
+            outcomes[i][1] == oracle[i] for i in admitted
+        ),
+        "all_sheds_explicit": not errors,  # no hangs, no silent drops
+        "no_hangs": not hung,
+        "all_settled": server.pending == 0,
+    }
+    if specs:
+        row["fault_fired"] = inj.total_fired() >= 1
+    if expect_sheds:
+        row["sheds_happened"] = len(sheds) >= 1
+        row["some_admitted"] = len(admitted) >= 1
+    if retries:
+        row["retry_recovered"] = len(admitted) == len(items)
+    return row
+
+
+def _serve_drain_trial(items, oracle):
+    """Graceful drain: queued (never-flushed) requests settle on close,
+    no ticket is left unsettled, post-close submits reject explicitly."""
+    from bitcoinconsensus_tpu.crypto.jax_backend import default_verifier
+    from bitcoinconsensus_tpu.serving import OverloadError, VerifyServer
+
+    sig_cache, script_cache = _fresh_caches()
+    # flush_s far beyond the trial: only close() can flush these.
+    server = VerifyServer(
+        sig_cache=sig_cache, script_cache=script_cache,
+        max_batch=64, flush_s=30.0, tenant_depth=16,
+    ).start()
+    pend = [(i, server.submit(items[i])) for i in range(5)]
+    server.close(drain=True)
+    settled = [(i, p.result(timeout=1).ok) for i, p in pend if p.done()]
+    try:
+        server.submit(items[0])
+        explicit_reject = False
+    except OverloadError as e:
+        explicit_reject = e.reason == "closed"
+    return {
+        "trial": "serve-drain",
+        "fired": {},
+        "bit_identical": [ok for _, ok in settled]
+        == [oracle[i] for i, _ in pend],
+        "drained_clean": len(settled) == len(pend) and server.pending == 0,
+        "no_unsettled_tickets": default_verifier()._inflight.depth == 0,
+        "explicit_reject_after_close": explicit_reject,
+    }
+
+
+def _serve_slo_trial():
+    """Admission-controller unit leg: SLO quantiles from a primed
+    histogram shed deep queues, and a quarantined ladder sheds earlier
+    (same depth admitted at rung 0, shed at rung 1)."""
+    from bitcoinconsensus_tpu.obs.metrics import Histogram
+    from bitcoinconsensus_tpu.resilience.degrade import Ladder
+    from bitcoinconsensus_tpu.serving import AdmissionController, SloTracker
+
+    hist = Histogram("serve_slo_trial", buckets=(0.1, 0.5, 1.0, 5.0))
+    slo = SloTracker(histogram=hist)
+    ladder = Ladder(("pallas", "xla", "host"), "serve-slo-trial")
+    ctl = AdmissionController(
+        1.2, batch_capacity=8, slo=slo, ladder=ladder
+    )
+    admit_cold = ctl.admit(10 ** 6) is None  # no latency evidence yet
+    for _ in range(50):
+        slo.observe(0.4)  # p99 estimate -> 0.5 bucket edge
+    admit_shallow = ctl.admit(0) is None        # 1 batch * 0.5 <= 1.2
+    shed_deep = ctl.admit(16) == "slo"          # 3 batches * 0.5 > 1.2
+    shed_rung0 = ctl.admit(8)                   # 2 * 0.5 = 1.0 <= 1.2
+    for _ in range(ladder.demote_after):
+        ladder.report("pallas", ok=False)       # quarantine -> rung 1
+    shed_rung1 = ctl.admit(8)                   # budget now 0.6 < 1.0
+    return {
+        "trial": "serve-slo-admission",
+        "fired": {},
+        "bit_identical": True,  # unit leg: no verdicts involved
+        "admit_cold_start": admit_cold,
+        "admit_shallow": admit_shallow,
+        "shed_on_deep_queue": shed_deep,
+        "quarantined_sheds_earlier": shed_rung0 is None
+        and shed_rung1 == "slo",
+    }
+
+
+def _serve_overhead(items):
+    """Disarmed serving-machinery cost (admission checks, queue ops, SLO
+    bookkeeping) as a fraction of pumping the workload through a live
+    server — hook-timing accounting, same style as `_overhead_budget`."""
+    from bitcoinconsensus_tpu.serving import queue as SQ
+    from bitcoinconsensus_tpu.serving import server as SS
+    from bitcoinconsensus_tpu.serving import shedding as SH
+
+    def run():
+        sig_cache, script_cache = _fresh_caches()
+        with SS.VerifyServer(
+            sig_cache=sig_cache, script_cache=script_cache,
+            max_batch=len(items), flush_s=0.001, tenant_depth=len(items),
+        ) as srv:
+            pend = [srv.submit(it) for it in items]
+            for p in pend:
+                p.result(timeout=120)
+
+    run()  # warm jit/compile caches; timing below excludes compiles
+    wall = min(_timed(run) for _ in range(3))
+
+    targets = [
+        (SH.AdmissionController, "admit"), (SH.SloTracker, "observe"),
+        (SQ.CoalescingQueue, "put"), (SQ.CoalescingQueue, "_pop_fair"),
+        (SS.VerifyServer, "_note_flush"), (SS.VerifyServer, "_shed_count"),
+    ]
+    spent = {f"{o.__name__}.{n}": 0.0 for o, n in targets}
+    calls = {f"{o.__name__}.{n}": 0 for o, n in targets}
+    saved = [(o, n, getattr(o, n)) for o, n in targets]
+
+    def _timing(key, fn):
+        def wrapper(*a, **kw):
+            t0 = time.perf_counter()
+            try:
+                return fn(*a, **kw)
+            finally:
+                spent[key] += time.perf_counter() - t0
+                calls[key] += 1
+        return wrapper
+
+    try:
+        for o, n, fn in saved:
+            setattr(o, n, _timing(f"{o.__name__}.{n}", fn))
+        run()
+    finally:
+        for o, n, fn in saved:
+            setattr(o, n, fn)
+
+    total = sum(spent.values())
+    return {
+        "wall_s": wall,
+        "hooks_s": total,
+        "ratio": total / wall,
+        "hook_calls": {k: v for k, v in sorted(calls.items()) if v},
+        "budget_ok": total < 0.01 * wall,
+    }
+
+
+def run_serve_sweep(seed: int) -> dict:
+    """Serving front-end sweep: concurrent clients vs faults + overload."""
+    from bitcoinconsensus_tpu.resilience import FaultSpec
+
+    items, oracle = _serve_items_and_oracle()
+    normal = dict(max_batch=8, flush_s=0.005, tenant_depth=64)
+    # Synthetic overload: nothing size-flushes (max_batch > offered
+    # load), the time flush is slow, and each tenant may queue only 2 —
+    # a burst of 3 back-to-back submits per tenant must shed its third.
+    overload = dict(max_batch=64, flush_s=0.05, tenant_depth=2)
+
+    trials = [
+        _serve_trial("serve-clean", items, oracle, [], seed, normal),
+        # Driver fault under concurrent serving: the resilience layer
+        # contains it below the server, verdicts stay bit-identical.
+        _serve_trial(
+            "serve-batch-dispatch-raise", items, oracle,
+            [FaultSpec("batch.dispatch", "raise")], seed, normal,
+        ),
+        _serve_trial(
+            "serve-overload-shed", items, oracle, [], seed, overload,
+            expect_sheds=True,
+        ),
+        _serve_trial(
+            "serve-overload-retry", items, oracle, [], seed, overload,
+            retries=12,
+        ),
+        # Overload AND a fault at once: sheds stay explicit, admitted
+        # verdicts stay exact, nothing hangs.
+        _serve_trial(
+            "serve-overload-fault", items, oracle,
+            [FaultSpec("batch.dispatch", "raise")], seed, overload,
+            retries=12,
+        ),
+        _serve_drain_trial(items, oracle),
+        _serve_slo_trial(),
+    ]
+    overhead = _serve_overhead(items)
+    return {"seed": seed, "serve": True, "trials": trials,
+            "overhead": overhead}
+
+
 def _problems(report: dict) -> list:
     probs = []
     for t in report["trials"]:
         if not t["bit_identical"]:
             probs.append(f"{t['trial']}: verdicts differ from host oracle")
-        if t["trial"] != "clean" and not t["fault_fired"]:
+        if t["trial"] != "clean" and t.get("fault_fired") is False:
             probs.append(f"{t['trial']}: armed fault never fired (dead site?)")
         for key in ("verdict_correct", "quarantined_to_host",
                     "flip_caught_by_checksum", "deadline_convicted",
                     "eviction_happened", "continued_bit_identical",
-                    "repromoted"):
+                    "repromoted",
+                    # serving sweep hard criteria
+                    "all_sheds_explicit", "no_hangs", "all_settled",
+                    "sheds_happened", "some_admitted", "retry_recovered",
+                    "drained_clean", "no_unsettled_tickets",
+                    "explicit_reject_after_close", "admit_cold_start",
+                    "admit_shallow", "shed_on_deep_queue",
+                    "quarantined_sheds_earlier"):
             if t.get(key) is False:
                 probs.append(f"{t['trial']}: {key} is False")
     ov = report["overhead"]
+    spent_s = ov.get("hooks_s", ov.get("resilience_s", 0.0))
     if not ov["budget_ok"]:
         probs.append(
-            f"resilience overhead {ov['resilience_s'] * 1e6:.0f}us is "
-            f">= 1% of verify_batch wall {ov['wall_s'] * 1e3:.2f}ms"
+            f"disarmed hook overhead {spent_s * 1e6:.0f}us is "
+            f">= 1% of workload wall {ov['wall_s'] * 1e3:.2f}ms"
         )
     return probs
 
@@ -650,9 +941,18 @@ def main(argv=None) -> int:
     ap.add_argument("--mesh", action="store_true",
                     help="run the shard fault-domain sweep over a forced "
                     "8-device mesh instead of the single-device sweep")
+    ap.add_argument("--serve", action="store_true",
+                    help="run the serving-layer sweep: concurrent client "
+                    "threads against injected faults and synthetic "
+                    "overload through a live VerifyServer")
     args = ap.parse_args(argv)
 
-    report = run_mesh_sweep(args.seed) if args.mesh else run_sweep(args.seed)
+    if args.serve:
+        report = run_serve_sweep(args.seed)
+    elif args.mesh:
+        report = run_mesh_sweep(args.seed)
+    else:
+        report = run_sweep(args.seed)
     doc = json.dumps(report, indent=2)
     if args.report:
         with open(args.report, "w", encoding="utf-8") as fh:

@@ -87,6 +87,17 @@ REQUIRED_METRICS = [
     "consensus_inflight_depth",
     "consensus_inflight_tickets_total",
     "consensus_inflight_settle_seconds",
+    # serving front end (admission + coalescing + SLO shedding; the
+    # workload's serving leg admits a small fan-in and forces one
+    # explicit shed so both sides of the admission decision sample)
+    "consensus_serving_admitted_total",
+    "consensus_serving_shed_total",
+    "consensus_serving_queue_depth",
+    "consensus_serving_queue_wait_seconds",
+    "consensus_serving_batch_fill",
+    "consensus_serving_batch_seconds",
+    "consensus_serving_slo_seconds",
+    "consensus_serving_batches_total",
     # spans
     "consensus_span_duration_seconds",
 ]
@@ -155,6 +166,23 @@ def run_mini_workload() -> None:
     for _pass in range(2):
         res = verify_batch(items)
         assert [r.ok for r in res] == [True] * 4 + [False]
+
+    # --- serving front end: coalesced fan-in from two tenants, then a
+    # deliberate overload (tenant_depth=1, no time flush) so the shed
+    # counter and both admission outcomes sample ---
+    from bitcoinconsensus_tpu.serving import OverloadError, VerifyServer
+
+    with VerifyServer(max_batch=8, flush_s=0.005, tenant_depth=8) as srv:
+        pend = [
+            srv.submit(it, tenant=f"tenant{i % 2}")
+            for i, it in enumerate(items[:4])
+        ]
+        assert [p.result(timeout=60).ok for p in pend] == [True] * 4
+    srv2 = VerifyServer(max_batch=64, flush_s=30.0, tenant_depth=1).start()
+    queued = srv2.submit(items[0])
+    expect(api.Error.ERR_OVERLOADED, srv2.submit, items[1])
+    srv2.close(drain=True)  # graceful drain settles the queued request
+    assert queued.result(timeout=60).ok and srv2.pending == 0
 
     # --- block connect: one valid block, one failing replay ---
     bview, bfunded = blockgen.make_funded_view(4, height=1, seed="stats-blk")

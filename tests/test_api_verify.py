@@ -121,3 +121,85 @@ def test_height_to_flags():
     assert height_to_flags(173805) != 0
     all_flags = height_to_flags(481824)
     assert all_flags == VERIFY_ALL_LIBCONSENSUS
+
+
+# -- verify_with_spent_outputs error paths ----------------------------
+# The extended entry point is the serving layer's submit surface, so its
+# rejects must be explicit typed errors, never partial evaluation.
+
+
+def _spent_outputs_ok():
+    from bitcoinconsensus_tpu import verify_with_spent_outputs
+
+    verify_with_spent_outputs(
+        bytes.fromhex(P2PKH_SPENDING), 0,
+        [(0, bytes.fromhex(P2PKH_SPENT))],
+        flags=VERIFY_ALL_LIBCONSENSUS,
+    )
+
+
+def test_spent_outputs_happy_path():
+    _spent_outputs_ok()  # baseline: the error cases below are real
+
+
+def test_spent_outputs_index_out_of_range():
+    from bitcoinconsensus_tpu import verify_with_spent_outputs
+
+    for bad_index in (1, 5, -1):
+        with pytest.raises(ConsensusError) as ei:
+            verify_with_spent_outputs(
+                bytes.fromhex(P2PKH_SPENDING), bad_index,
+                [(0, bytes.fromhex(P2PKH_SPENT))],
+            )
+        assert ei.value.code == Error.ERR_TX_INDEX
+
+
+def test_spent_outputs_count_must_match_inputs():
+    """One-input tx with two spent outputs: a valid index is not enough —
+    the per-input prevout list must cover the whole tx (Core's
+    verify_script_with_spent_outputs ABI contract)."""
+    from bitcoinconsensus_tpu import verify_with_spent_outputs
+
+    with pytest.raises(ConsensusError) as ei:
+        verify_with_spent_outputs(
+            bytes.fromhex(P2PKH_SPENDING), 0,
+            [(0, bytes.fromhex(P2PKH_SPENT)),
+             (0, bytes.fromhex(P2PKH_SPENT))],
+        )
+    assert ei.value.code == Error.ERR_TX_INDEX
+
+
+def test_spent_outputs_undeserializable_tx():
+    from bitcoinconsensus_tpu import verify_with_spent_outputs
+
+    with pytest.raises(ConsensusError) as ei:
+        verify_with_spent_outputs(
+            b"\x02\x00\x00\x00junk", 0,
+            [(0, bytes.fromhex(P2PKH_SPENT))],
+        )
+    assert ei.value.code == Error.ERR_TX_DESERIALIZE
+
+
+def test_spent_outputs_invalid_flags():
+    from bitcoinconsensus_tpu import verify_with_spent_outputs
+
+    with pytest.raises(ConsensusError) as ei:
+        verify_with_spent_outputs(
+            bytes.fromhex(P2PKH_SPENDING), 0,
+            [(0, bytes.fromhex(P2PKH_SPENT))],
+            flags=1 << 30,
+        )
+    assert ei.value.code == Error.ERR_INVALID_FLAGS
+
+
+def test_spent_outputs_corrupt_script_fails_as_script_error():
+    from bitcoinconsensus_tpu import verify_with_spent_outputs
+
+    bad_spk = bytearray(bytes.fromhex(P2PKH_SPENT))
+    bad_spk[5] ^= 0x01  # corrupt the pubkey-hash: signature check fails
+    with pytest.raises(ConsensusError) as ei:
+        verify_with_spent_outputs(
+            bytes.fromhex(P2PKH_SPENDING), 0,
+            [(0, bytes(bad_spk))],
+        )
+    assert ei.value.code == Error.ERR_SCRIPT

@@ -472,3 +472,106 @@ def test_batch_all_script_cache_hits():
                           script_cache=script_cache)
     assert [r.ok for r in second] == [True] * 3
     assert script_cache.hits == hits0 + len(items)  # every item a hit
+
+
+# -- stream abandonment (generator close must settle the window) ------
+
+
+class _RecordingVerifier:
+    """Stub verifier: records sync_lanes calls, optionally raising."""
+
+    def __init__(self, raise_on=()):
+        self.calls = []
+        self.raise_on = set(raise_on)
+
+    def sync_lanes(self, pend, n):
+        self.calls.append((pend, n))
+        if pend in self.raise_on:
+            raise RuntimeError(f"settle failed for {pend}")
+
+
+def _stub_fixpoint(verifier):
+    from bitcoinconsensus_tpu.models.batch import IdxFixpoint
+
+    return IdxFixpoint(
+        nsess=None,
+        verifier=verifier,
+        sig_cache=None,
+        live=[0, 1],
+        run_idx=lambda pos: None,
+        exact_fallback=lambda idx: (False, 0),
+    )
+
+
+def test_idx_fixpoint_abandon_settles_inflight_tickets():
+    """abandon() must sync every pending device ticket of the in-flight
+    round (they hold buffers and backpressure slots) and clear the run,
+    without executing the fixpoint."""
+    v = _RecordingVerifier()
+    run = _stub_fixpoint(v)
+    run._in_flight = (
+        ("interp",), ("grow", ("k1", "k2"), [("pend1", [1, 2]), ("pend2", [3])])
+    )
+    run.abandon()
+    assert v.calls == [("pend1", 2), ("pend2", 1)]
+    assert run._in_flight is None and run._pending == []
+
+
+def test_idx_fixpoint_abandon_contains_settle_failures():
+    """A ticket whose settle raises must not stop the remaining tickets
+    from settling — abandonment is best-effort containment."""
+    v = _RecordingVerifier(raise_on={"bad"})
+    run = _stub_fixpoint(v)
+    run._in_flight = (
+        ("interp",), ("grow", (), [("bad", [1]), ("good", [2, 3])])
+    )
+    run.abandon()  # must not raise
+    assert v.calls == [("bad", 1), ("good", 2)]
+    assert run._in_flight is None and run._pending == []
+
+
+def test_idx_fixpoint_abandon_without_inflight_round():
+    run = _stub_fixpoint(_RecordingVerifier())
+    run.abandon()
+    assert run._pending == [] and run._in_flight is None
+
+
+def test_abandon_stream_window_only_touches_idx_handles():
+    from bitcoinconsensus_tpu.models.batch import _abandon_stream_window
+
+    class _Run:
+        abandoned = 0
+
+        def abandon(self):
+            _Run.abandoned += 1
+
+    window = [
+        ("idx", _Run(), [], []),
+        ("done", ["results"]),       # already settled: nothing to do
+        ("idx", None, [], []),       # begin() refused: no run object
+        ("idx", _Run(), [], []),
+    ]
+    _abandon_stream_window(window)
+    assert _Run.abandoned == 2
+    assert window == []
+
+
+def test_batch_stream_close_leaves_no_inflight_tickets():
+    """Closing the stream generator mid-flight (the abandoned-consumer
+    path) must settle every begun batch: the verifier's in-flight queue
+    drains to depth 0 and keeps serving later batches."""
+    from bitcoinconsensus_tpu.crypto.jax_backend import default_verifier
+
+    batches = []
+    for seed in ("close-1", "close-2", "close-3"):
+        txb, spk, amt = make_p2wpkh_spend(seed)
+        batches.append([BatchItem(txb, 0, VERIFY_ALL_LIBCONSENSUS,
+                                  spent_output_script=spk, amount=amt)])
+    gen = verify_batch_stream(iter(batches), depth=2)
+    first = next(gen)  # window now holds begun-but-unfinished batches
+    assert [r.ok for r in first] == [True]
+    gen.close()  # GeneratorExit -> finally -> window abandonment
+    assert default_verifier()._inflight.depth == 0
+    # The pipeline is still healthy: a fresh batch verifies normally.
+    again = verify_batch(batches[0])
+    assert [r.ok for r in again] == [True]

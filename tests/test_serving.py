@@ -1,0 +1,383 @@
+"""Serving-layer tests: fair coalescing queue, SLO admission control,
+overload shedding, graceful drain, and the bounded-retry client.
+
+Unit tests drive the queue/shedding policy objects with injected clocks
+and histograms (fully deterministic, no device work); the end-to-end
+tests run a real `VerifyServer` over the CPU verifier and assert the
+serving layer is a pure transport: verdicts bit-identical to a direct
+`verify_batch`, sheds explicit (`Error.ERR_OVERLOADED`), shutdown
+settling everything admitted.
+"""
+
+import threading
+import types
+
+import pytest
+
+from conftest import *  # noqa: F401,F403 (env setup)
+
+from bitcoinconsensus_tpu.api import Error
+from bitcoinconsensus_tpu.core.flags import VERIFY_ALL_LIBCONSENSUS
+from bitcoinconsensus_tpu.models.batch import BatchItem, verify_batch
+from bitcoinconsensus_tpu.obs import get_registry
+from bitcoinconsensus_tpu.obs.metrics import Histogram
+from bitcoinconsensus_tpu.resilience.degrade import Ladder
+from bitcoinconsensus_tpu.serving import (
+    SHED_CLOSED,
+    SHED_SLO,
+    SHED_TENANT_FULL,
+    AdmissionController,
+    CoalescingQueue,
+    OverloadError,
+    QueueClosed,
+    SloTracker,
+    TenantQueueFull,
+    VerifyServer,
+    verify_with_retry,
+)
+
+from test_batch import make_p2wpkh_spend
+
+
+def _entry(tenant, enqueued=0.0):
+    return types.SimpleNamespace(tenant=tenant, enqueued=enqueued)
+
+
+def _items(n=4, bad_first=True):
+    """n single-input BatchItems; item 0 corrupt when bad_first."""
+    out = []
+    for i in range(n):
+        txb, spk, amt = make_p2wpkh_spend(
+            f"serve-test-{i}", corrupt=(bad_first and i == 0)
+        )
+        out.append(BatchItem(txb, 0, VERIFY_ALL_LIBCONSENSUS,
+                             spent_output_script=spk, amount=amt))
+    return out
+
+
+# -- Histogram.quantile (the shedding signal's foundation) ------------
+
+
+def test_histogram_quantile_empty_is_none():
+    h = Histogram("t_serv_q_empty", buckets=(1.0, 2.0))
+    assert h.quantile(0.5) is None
+    assert h.quantile(0.99) is None
+
+
+def test_histogram_quantile_upper_bucket_edge():
+    """quantile() is a conservative upper estimate: it returns the edge
+    of the first bucket whose cumulative count reaches the rank."""
+    h = Histogram("t_serv_q_edges", buckets=(0.1, 1.0, 10.0))
+    for _ in range(9):
+        h.observe(0.05)   # bucket le=0.1
+    h.observe(5.0)        # bucket le=10.0
+    assert h.quantile(0.5) == 0.1
+    assert h.quantile(0.9) == 0.1
+    assert h.quantile(0.99) == 10.0
+    assert h.quantile(1.0) == 10.0
+
+
+def test_histogram_quantile_overflow_is_inf():
+    import math
+
+    h = Histogram("t_serv_q_inf", buckets=(0.1,))
+    h.observe(99.0)  # lands in the +Inf bucket
+    assert h.quantile(0.5) == math.inf
+
+
+def test_histogram_quantile_rejects_bad_q():
+    h = Histogram("t_serv_q_badq", buckets=(1.0,))
+    for q in (0.0, -0.5, 1.5):
+        with pytest.raises(ValueError):
+            h.quantile(q)
+
+
+# -- CoalescingQueue --------------------------------------------------
+
+
+def test_queue_pop_is_round_robin_fair():
+    """A flooding tenant gets one entry per rotation turn: a1 a2 a3 then
+    b1 c1 must pop as a1 b1 c1 (one per tenant), not a1 a2 a3."""
+    q = CoalescingQueue(tenant_depth=8)
+    for e in (_entry("a"), _entry("a"), _entry("a"),
+              _entry("b"), _entry("c")):
+        q.put(e)
+    got = q.take(3, flush_s=0.0)
+    assert [e.tenant for e in got] == ["a", "b", "c"]
+    got = q.take(3, flush_s=0.0)
+    assert [e.tenant for e in got] == ["a", "a"]
+    assert q.total == 0
+
+
+def test_queue_tenant_depth_bound():
+    q = CoalescingQueue(tenant_depth=2)
+    q.put(_entry("a"))
+    q.put(_entry("a"))
+    with pytest.raises(TenantQueueFull):
+        q.put(_entry("a"))
+    q.put(_entry("b"))  # other tenants unaffected
+    assert q.total == 3 and q.depth("a") == 2 and q.depth("b") == 1
+
+
+def test_queue_size_trigger_pops_immediately():
+    q = CoalescingQueue(tenant_depth=8)
+    q.put(_entry("a"))
+    q.put(_entry("b"))
+    # flush_s is huge but total >= max_n: must not wait.
+    got = q.take(2, flush_s=3600.0)
+    assert len(got) == 2
+
+
+def test_queue_time_trigger_via_injected_clock():
+    now = [100.0]
+    q = CoalescingQueue(tenant_depth=8, clock=lambda: now[0])
+    q.put(_entry("a", enqueued=100.0))
+    now[0] = 100.2  # oldest has waited 0.2s > flush_s=0.1
+    got = q.take(8, flush_s=0.1)
+    assert len(got) == 1
+
+
+def test_queue_nonblocking_take_returns_none_when_empty():
+    q = CoalescingQueue(tenant_depth=8)
+    assert q.take(8, flush_s=0.0, block=False) is None
+
+
+def test_queue_close_drains_then_none_and_rejects_put():
+    q = CoalescingQueue(tenant_depth=8)
+    q.put(_entry("a"))
+    q.close()
+    with pytest.raises(QueueClosed):
+        q.put(_entry("a"))
+    assert len(q.take(8, flush_s=3600.0)) == 1  # drain flushes at once
+    assert q.take(8, flush_s=3600.0) is None    # empty + closed
+
+
+def test_queue_cancel_all_returns_everything():
+    q = CoalescingQueue(tenant_depth=8)
+    for e in (_entry("a"), _entry("a"), _entry("b")):
+        q.put(e)
+    cancelled = q.cancel_all()
+    assert len(cancelled) == 3 and q.total == 0
+    assert q.take(8, flush_s=0.0, block=False) is None
+
+
+# -- SloTracker / AdmissionController ---------------------------------
+
+
+def test_slo_tracker_publishes_quantile_gauges():
+    h = Histogram("t_serv_slo_gauges", buckets=(0.1, 0.5, 1.0))
+    slo = SloTracker(histogram=h)
+    for _ in range(50):
+        slo.observe(0.05)
+    for _ in range(50):
+        slo.observe(0.7)
+    assert slo.quantile(0.5) == 0.1
+    assert slo.quantile(0.99) == 1.0
+    g = get_registry().get("consensus_serving_slo_seconds")
+    assert g.value(q="p50") == 0.1
+    assert g.value(q="p99") == 1.0
+
+
+def test_admission_cold_start_always_admits():
+    slo = SloTracker(histogram=Histogram("t_serv_adm_cold",
+                                         buckets=(1.0,)))
+    adm = AdmissionController(0.001, batch_capacity=1, slo=slo)
+    assert adm.admit(10**6) is None  # no latency evidence yet
+
+
+def test_admission_sheds_on_projected_queue_wait():
+    slo = SloTracker(histogram=Histogram("t_serv_adm_shed",
+                                         buckets=(0.1, 0.5, 1.0)))
+    for _ in range(50):
+        slo.observe(0.4)  # p99 -> 0.5
+    adm = AdmissionController(1.2, batch_capacity=8, slo=slo)
+    # 0 queued: 1 batch ahead, 0.5s projected <= 1.2s budget -> admit.
+    assert adm.admit(0) is None
+    # 17 queued: 3 batches ahead, 1.5s projected > 1.2s -> shed.
+    assert adm.admit(17) == SHED_SLO
+
+
+def test_admission_quarantined_mesh_sheds_earlier():
+    slo = SloTracker(histogram=Histogram("t_serv_adm_ladder",
+                                         buckets=(0.1, 0.5, 1.0)))
+    for _ in range(50):
+        slo.observe(0.4)
+    ladder = Ladder(("pallas", "xla", "host"), "serv-adm-test")
+    adm = AdmissionController(1.2, batch_capacity=8, slo=slo,
+                              ladder=ladder)
+    assert adm.deadline_budget_s() == 1.2
+    assert adm.admit(8) is None  # 2 batches * 0.5 = 1.0 <= 1.2
+    # Demote to the xla rung: budget halves, same depth now sheds.
+    ladder.report("pallas", ok=False)
+    ladder.report("pallas", ok=False)
+    assert ladder.current == "xla"
+    assert adm.deadline_budget_s() == pytest.approx(0.6)
+    assert adm.admit(8) == SHED_SLO
+    assert adm.admit(0) is None  # shallow queue still admitted
+
+
+def test_admission_rejects_bad_config():
+    slo = SloTracker(histogram=Histogram("t_serv_adm_cfg", buckets=(1.0,)))
+    with pytest.raises(ValueError):
+        AdmissionController(0.0, batch_capacity=8, slo=slo)
+    with pytest.raises(ValueError):
+        AdmissionController(1.0, batch_capacity=0, slo=slo)
+
+
+# -- VerifyServer end to end ------------------------------------------
+
+
+@pytest.mark.slow
+def test_server_concurrent_verdicts_bit_identical():
+    """The serving layer is pure transport: concurrent multi-tenant
+    submits must settle to verdicts identical to a direct verify_batch
+    of the same items."""
+    items = _items(6, bad_first=True)
+    want = [(r.ok, r.error) for r in verify_batch(items)]
+
+    results = [None] * len(items)
+
+    with VerifyServer(max_batch=4, flush_s=0.005, tenant_depth=16) as srv:
+        def client(i):
+            res = srv.verify(items[i], tenant=f"t{i % 3}", timeout=120)
+            results[i] = (res.ok, res.error)
+
+        threads = [threading.Thread(target=client, args=(i,))
+                   for i in range(len(items))]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(180)
+    assert results == want
+    assert srv.pending == 0
+
+
+def test_server_tenant_full_sheds_explicitly():
+    """tenant_depth=1 with a never-firing flush: the second submit from
+    the same tenant must raise ERR_OVERLOADED immediately — an explicit
+    reject, never a hang — while the queued request still settles on
+    drain."""
+    items = _items(2, bad_first=False)
+    srv = VerifyServer(max_batch=64, flush_s=30.0, tenant_depth=1).start()
+    try:
+        queued = srv.submit(items[0])
+        with pytest.raises(OverloadError) as ei:
+            srv.submit(items[1])
+        assert ei.value.code == Error.ERR_OVERLOADED
+        assert ei.value.reason == SHED_TENANT_FULL
+    finally:
+        srv.close(drain=True)
+    assert queued.result(timeout=60).ok
+    assert srv.pending == 0
+
+
+def test_server_drain_settles_and_post_close_rejects():
+    items = _items(3, bad_first=False)
+    srv = VerifyServer(max_batch=64, flush_s=30.0, tenant_depth=8).start()
+    pend = [srv.submit(it) for it in items]
+    assert not any(p.done() for p in pend)  # flush never fired
+    srv.close(drain=True)  # drain trigger flushes + settles everything
+    assert all(p.result(timeout=60).ok for p in pend)
+    assert srv.pending == 0
+    with pytest.raises(OverloadError) as ei:
+        srv.submit(items[0])
+    assert ei.value.reason == SHED_CLOSED
+    srv.close()  # idempotent
+
+
+def test_server_nondrain_close_cancels_explicitly():
+    items = _items(1, bad_first=False)
+    srv = VerifyServer(max_batch=64, flush_s=30.0, tenant_depth=8).start()
+    pend = srv.submit(items[0])
+    srv.close(drain=False)
+    with pytest.raises(OverloadError) as ei:
+        pend.result(timeout=10)
+    assert ei.value.reason == SHED_CLOSED
+    assert srv.pending == 0
+
+
+def test_server_worker_exception_fails_requests_explicitly(monkeypatch):
+    """A batch-driver crash must fail every windowed request with the
+    exception — explicitly, not by leaving futures unresolved."""
+    import bitcoinconsensus_tpu.serving.server as server_mod
+
+    def boom(*a, **k):
+        raise RuntimeError("driver crashed")
+        yield  # pragma: no cover - makes this a generator function
+
+    monkeypatch.setattr(server_mod, "verify_batch_stream", boom)
+    items = _items(2, bad_first=False)
+    srv = VerifyServer(max_batch=2, flush_s=0.001, tenant_depth=8).start()
+    try:
+        p0 = srv.submit(items[0])
+        p1 = srv.submit(items[1])
+        with pytest.raises(RuntimeError, match="driver crashed"):
+            p0.result(timeout=30)
+        with pytest.raises(RuntimeError, match="driver crashed"):
+            p1.result(timeout=30)
+    finally:
+        srv.close(drain=True)
+    assert srv.pending == 0
+
+
+def test_server_submit_before_start_rejects():
+    srv = VerifyServer(max_batch=4, flush_s=0.005, tenant_depth=8)
+    with pytest.raises(OverloadError) as ei:
+        srv.submit(_items(1, bad_first=False)[0])
+    assert ei.value.reason == SHED_CLOSED
+    srv.close()  # close without start is a no-op
+
+
+# -- bounded-retry client ---------------------------------------------
+
+
+class _StubPending:
+    def __init__(self, value):
+        self._value = value
+
+    def result(self, timeout=None):
+        return self._value
+
+
+class _StubServer:
+    """Sheds the first `sheds` submits, then accepts."""
+
+    def __init__(self, sheds):
+        self.sheds = sheds
+        self.calls = 0
+
+    def submit(self, item, tenant="default"):
+        self.calls += 1
+        if self.calls <= self.sheds:
+            raise OverloadError(SHED_SLO)
+        return _StubPending(("ok", item, tenant))
+
+
+def test_retry_client_recovers_after_sheds():
+    import random
+
+    srv = _StubServer(sheds=3)
+    got = verify_with_retry(srv, "item", tenant="t0", retries=4,
+                            backoff_s=0.001, max_backoff_s=0.002,
+                            rng=random.Random(7))
+    assert got == ("ok", "item", "t0")
+    assert srv.calls == 4  # 3 sheds + 1 success
+
+
+def test_retry_client_exhausted_budget_reraises():
+    import random
+
+    srv = _StubServer(sheds=100)
+    with pytest.raises(OverloadError):
+        verify_with_retry(srv, "item", retries=2, backoff_s=0.001,
+                          max_backoff_s=0.002, rng=random.Random(7))
+    assert srv.calls == 3  # initial + 2 retries
+
+
+def test_retry_client_non_shed_errors_propagate():
+    class _Broken:
+        def submit(self, item, tenant="default"):
+            raise ValueError("not a shed")
+
+    with pytest.raises(ValueError):
+        verify_with_retry(_Broken(), "item", retries=5, backoff_s=0.001)

@@ -142,3 +142,50 @@ def test_registry_metrics_mirror_cache_counters():
         m("insertions_total") - m("evictions_total") - m("erases_total")
         == len(sig)
     )
+
+
+def test_concurrent_hammer_preserves_accounting_invariant():
+    """Threads racing insert / erase-on-hit / discard on a small LRU:
+    whatever interleaving happens, the byte-for-byte accounting must
+    close — insertions - evictions - erases == live entries. A hole here
+    means a lost ticket: an entry (or its counter) dropped on a race,
+    exactly the failure mode the serving layer's shared caches would
+    amplify under concurrent tenants."""
+    import threading
+
+    sig = SigCache(max_entries=64, cache_label="hammer")
+    n_threads, n_ops = 8, 400
+    barrier = threading.Barrier(n_threads)
+    errors = []
+
+    def worker(tid):
+        try:
+            barrier.wait()
+            for i in range(n_ops):
+                data = (b"pk%d" % (i % 97), b"sig%d" % (tid % 3), b"m")
+                op = (tid + i) % 4
+                if op == 0:
+                    sig.add_check("ecdsa", data)
+                elif op == 1:
+                    sig.contains_check("ecdsa", data, erase=True)
+                elif op == 2:
+                    sig.contains_check("ecdsa", data)
+                else:
+                    sig.discard_key(sig._key(sig._parts("ecdsa", data)))
+        except Exception as e:  # pragma: no cover - surfaced below
+            errors.append(e)
+
+    threads = [threading.Thread(target=worker, args=(t,))
+               for t in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(120)
+    assert not errors
+    assert not any(t.is_alive() for t in threads)
+    assert sig.insertions - sig.evictions - sig.erases == len(sig)
+    assert 0 <= len(sig) <= 64
+    # The cache still functions after the stampede.
+    sig.add_check("ecdsa", (b"post", b"hammer", b"m"))
+    assert sig.contains_check("ecdsa", (b"post", b"hammer", b"m"))
+    assert sig.insertions - sig.evictions - sig.erases == len(sig)
