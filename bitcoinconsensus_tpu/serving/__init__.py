@@ -7,10 +7,12 @@ pieces, bottom up:
 - `queue.CoalescingQueue` — per-tenant bounded FIFOs drained
   round-robin into `lane_capacity`-sized device batches (time-or-size
   flush); a full tenant slice rejects at put time.
-- `shedding.SloTracker` / `shedding.AdmissionController` — p50/p99
-  settle-latency gauges derived from `obs/` histogram buckets drive a
-  queueing-estimate admission check; a quarantined dispatch ladder
-  shrinks the deadline budget, so a sick mesh sheds earlier.
+- `shedding.SloTracker` / `shedding.AdmissionController` — p50/p99 over
+  a per-server sliding window of settle latencies drive a
+  queueing-estimate admission check over the full backlog (queued +
+  in flight); an empty backlog always admits (the probe that lets the
+  estimate recover), and a quarantined dispatch ladder shrinks the
+  deadline budget, so a sick mesh sheds earlier.
 - `server.VerifyServer` — the context-managed front end: submit() →
   admit-or-`OverloadError`, one worker thread drives bursts through
   `models/batch.verify_batch_stream`, close() drains (or explicitly

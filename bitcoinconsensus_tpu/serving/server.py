@@ -32,7 +32,8 @@ Env knobs (all optional): ``BITCOINCONSENSUS_TPU_SERVE_MAX_BATCH``
 ``..._SERVE_FLUSH_S`` (time-trigger flush, default 0.005),
 ``..._SERVE_TENANT_DEPTH`` (per-tenant queue bound, default 1024),
 ``..._SERVE_SLO_S`` (settle-deadline SLO, default 2.0),
-``..._SERVE_DEPTH`` (stream pipeline depth, default 2).
+``..._SERVE_SLO_WINDOW`` (latency samples kept for the shed estimate,
+default 128), ``..._SERVE_DEPTH`` (stream pipeline depth, default 2).
 """
 
 from __future__ import annotations
@@ -189,7 +190,12 @@ class VerifyServer:
             tenant_depth
             or _env_int("BITCOINCONSENSUS_TPU_SERVE_TENANT_DEPTH", 1024)
         )
-        self.slo = SloTracker()
+        # Per-server latency window: admission decisions stay isolated
+        # from other (possibly slow or defunct) server instances even
+        # though all of them feed the shared export histogram.
+        self.slo = SloTracker(
+            window=_env_int("BITCOINCONSENSUS_TPU_SERVE_SLO_WINDOW", 128)
+        )
         self.admission = AdmissionController(
             slo_deadline_s
             or _env_float("BITCOINCONSENSUS_TPU_SERVE_SLO_S", 2.0),
@@ -255,7 +261,10 @@ class VerifyServer:
         """Admit one request or raise `OverloadError` immediately."""
         if self._closing or self._closed or self._thread is None:
             raise self._shed(SHED_CLOSED)
-        reason = self.admission.admit(self._queue.total)
+        # Admission projects wait over the FULL backlog — queued plus
+        # the batches already in flight in the stream window; queued
+        # count alone would undersell the wait by up to depth * p99.
+        reason = self.admission.admit(self.pending)
         if reason is not None:
             raise self._shed(reason)
         req = PendingVerify(item, tenant, _monotonic())
@@ -321,6 +330,10 @@ class VerifyServer:
         traffic to settle.
         """
         inflight: deque = deque()
+        # In-flight from the moment of the queue pop (here and after
+        # every take below), so `pending` never transiently undercounts
+        # a popped-but-not-yet-streamed batch.
+        self._inflight_reqs += len(first)
         # The popped-but-not-yet-streamed batch: batches() consumes it on
         # first pull; if the driver crashes before pulling anything, the
         # except arm below still owns these requests and fails them.
@@ -330,11 +343,12 @@ class VerifyServer:
             reqs = unconsumed.pop() if unconsumed else None
             while reqs is not None:
                 inflight.append((reqs, self._note_flush(reqs)))
-                self._inflight_reqs += len(reqs)
                 yield [r.item for r in reqs]
                 reqs = self._queue.take(
                     self.max_batch, self.flush_s, block=False
                 )
+                if reqs is not None:
+                    self._inflight_reqs += len(reqs)
 
         current: Optional[list] = None
         try:
@@ -364,8 +378,10 @@ class VerifyServer:
                     req._fail(exc)
                 self._inflight_reqs -= len(reqs)
             if unconsumed:  # driver died before streaming the first batch
-                for req in unconsumed.pop():
+                reqs = unconsumed.pop()
+                for req in reqs:
                     req._fail(exc)
+                self._inflight_reqs -= len(reqs)
 
     def _note_flush(self, reqs: list) -> float:
         now = _monotonic()

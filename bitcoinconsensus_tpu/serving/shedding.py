@@ -1,16 +1,29 @@
 """SLO-driven load shedding: settle-latency quantiles → admission.
 
 The shed decision is a queueing estimate, not a vibe: the server
-observes every coalesced batch's settle latency into an `obs/`
-histogram; `SloTracker` derives p50/p99 from the cumulative bucket
-counts (`Histogram.quantile` — a conservative upper estimate) and
-publishes them as gauges. `AdmissionController` then asks, for each
-arriving request: *if admitted, how long until its batch settles?* —
-`ceil((queued + 1) / batch_capacity)` batches ahead, each costing ~p99.
-When that projected wait exceeds the deadline budget, the request is
-shed with an explicit `Error.ERR_OVERLOADED` (fail-closed reject, never
-a hang; the bounded-retry client in serving/client.py is the recovery
-path).
+observes every coalesced batch's settle latency into `SloTracker`,
+which keeps a bounded sliding window of the most recent samples and
+derives exact p50/p99 order statistics from it (published as gauges;
+each observation also feeds the exported
+``consensus_serving_batch_seconds`` histogram, which is a metrics sink
+only — admission never reads it). `AdmissionController` then asks, for
+each arriving request: *if admitted, how long until its batch settles?*
+— `ceil((backlog + 1) / batch_capacity)` batches ahead of it (queued
+AND in flight), each costing ~p99. When that projected wait exceeds the
+deadline budget, the request is shed with an explicit
+`Error.ERR_OVERLOADED` (fail-closed reject, never a hang; the
+bounded-retry client in serving/client.py is the recovery path).
+
+Shedding must be recoverable as well as fail-closed, so two rules keep
+the controller from latching shut: an **empty backlog always admits**
+(with nothing ahead of it the request cannot miss its deadline by
+queueing, and its settle is the probe that refreshes the latency
+window), and the window **ages out** old samples — a cold-compile tail
+or a since-quarantined slow rung stops dominating p99 after `window`
+further batches instead of poisoning a lifetime-cumulative estimate
+forever. The window is also per-`SloTracker` (per server), so one slow
+or defunct server instance in the process cannot contaminate another's
+admission decisions through the shared exported histogram.
 
 Ladder coupling (resilience/degrade.py): a quarantined mesh is already
 running on a slower rung and burning retry budget, so it sheds earlier —
@@ -21,6 +34,9 @@ it automatically; no separate shed state machine to thrash.
 
 from __future__ import annotations
 
+import math
+import threading
+from collections import deque
 from typing import Optional
 
 from ..obs import gauge as _obs_gauge
@@ -40,8 +56,8 @@ SHED_TENANT_FULL = "tenant_full"  # bounded per-tenant queue depth hit
 SHED_SLO = "slo"                  # projected queue wait blows the deadline
 
 # Batch settle latencies: 1 ms (warm cached replay) .. 10 s (cold
-# compile over the tunnel). Finer-grained than the generic span buckets
-# because the quantile estimate is only as sharp as the bucket edges.
+# compile over the tunnel). Export-only: admission reads the exact
+# sliding-window samples, not these bucket edges.
 _BATCH_LATENCY_BUCKETS = (
     0.001, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
 )
@@ -57,25 +73,47 @@ _SLO_GAUGE = _obs_gauge(
     ("q",),
 )
 
+DEFAULT_SLO_WINDOW = 128
+
 
 class SloTracker:
-    """Settle-latency histogram + derived p50/p99 gauges."""
+    """Sliding window of settle latencies + derived p50/p99 gauges.
 
-    def __init__(self, histogram=None):
+    Quantiles are exact order statistics over the last `window`
+    observations, so the estimate both tracks the current regime and
+    forgets old tails — the property the admission controller needs to
+    recover after a slow burst. The process-global export histogram is
+    fed on every observe but never read back.
+    """
+
+    def __init__(self, histogram=None, window: int = DEFAULT_SLO_WINDOW):
+        if window < 1:
+            raise ValueError("window must be >= 1")
         self._hist = histogram if histogram is not None else _BATCH_SECONDS
+        self._window: deque = deque(maxlen=window)
+        self._lock = threading.Lock()
         self._p50 = _SLO_GAUGE.labels(q="p50")
         self._p99 = _SLO_GAUGE.labels(q="p99")
 
     def observe(self, seconds: float) -> None:
         self._hist.observe(seconds)
-        p50, p99 = self._hist.quantile(0.5), self._hist.quantile(0.99)
-        if p50 is not None:
-            self._p50.set(p50)
-        if p99 is not None:
-            self._p99.set(p99)
+        with self._lock:
+            self._window.append(float(seconds))
+        self._p50.set(self.quantile(0.5))
+        self._p99.set(self.quantile(0.99))
 
     def quantile(self, q: float) -> Optional[float]:
-        return self._hist.quantile(q)
+        """Upper sample quantile of the window: the smallest observed
+        latency with at least a ``q`` fraction of samples at or below
+        it. None with no observations yet (cold start)."""
+        if not 0.0 < q <= 1.0:
+            raise ValueError("q must be in (0, 1]")
+        with self._lock:
+            if not self._window:
+                return None
+            samples = sorted(self._window)
+        rank = max(0, min(len(samples) - 1, math.ceil(q * len(samples)) - 1))
+        return samples[rank]
 
 
 class AdmissionController:
@@ -109,17 +147,24 @@ class AdmissionController:
     def deadline_budget_s(self) -> float:
         return self.slo_deadline_s / (1 + self.ladder_rung())
 
-    def admit(self, queued_total: int) -> Optional[str]:
+    def admit(self, backlog: int) -> Optional[str]:
         """None to admit, else the shed reason.
 
-        Cold start (no settled batches yet) always admits — there is no
-        latency evidence to shed on, and the per-tenant depth bound in
-        the queue still caps the damage a thundering herd can do.
+        `backlog` is everything ahead of the arriving request — queued
+        in the coalescer AND in flight on the device. Two unconditional
+        admits keep the controller recoverable: **cold start** (no
+        latency evidence to shed on; the per-tenant depth bound still
+        caps a thundering herd) and an **empty backlog** — with nothing
+        ahead, queueing cannot blow the deadline, and that request's
+        settle is the probe that refreshes the latency window, so a
+        slow tail can never latch the server into shedding forever.
         """
+        if backlog <= 0:
+            return None
         p99 = self.slo.quantile(0.99)
         if p99 is None:
             return None
-        batches_ahead = queued_total // self.batch_capacity + 1
+        batches_ahead = backlog // self.batch_capacity + 1
         if batches_ahead * p99 > self.deadline_budget_s():
             return SHED_SLO
         return None

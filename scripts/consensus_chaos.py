@@ -766,8 +766,10 @@ def _serve_drain_trial(items, oracle):
 
 def _serve_slo_trial():
     """Admission-controller unit leg: SLO quantiles from a primed
-    histogram shed deep queues, and a quarantined ladder sheds earlier
-    (same depth admitted at rung 0, shed at rung 1)."""
+    latency window shed deep queues, a quarantined ladder sheds earlier
+    (same depth admitted at rung 0, shed at rung 1), and shedding can
+    never latch shut — an empty backlog admits a probe whose settles
+    age the slow tail out of the window."""
     from bitcoinconsensus_tpu.obs.metrics import Histogram
     from bitcoinconsensus_tpu.resilience.degrade import Ladder
     from bitcoinconsensus_tpu.serving import AdmissionController, SloTracker
@@ -780,13 +782,28 @@ def _serve_slo_trial():
     )
     admit_cold = ctl.admit(10 ** 6) is None  # no latency evidence yet
     for _ in range(50):
-        slo.observe(0.4)  # p99 estimate -> 0.5 bucket edge
-    admit_shallow = ctl.admit(0) is None        # 1 batch * 0.5 <= 1.2
+        slo.observe(0.5)  # window p99 -> 0.5
+    admit_shallow = ctl.admit(4) is None        # 1 batch * 0.5 <= 1.2
     shed_deep = ctl.admit(16) == "slo"          # 3 batches * 0.5 > 1.2
     shed_rung0 = ctl.admit(8)                   # 2 * 0.5 = 1.0 <= 1.2
     for _ in range(ladder.demote_after):
         ladder.report("pallas", ok=False)       # quarantine -> rung 1
     shed_rung1 = ctl.admit(8)                   # budget now 0.6 < 1.0
+
+    # Recovery: a cold compile slower than the whole budget sheds only
+    # while a backlog exists; the empty-backlog probe path plus the
+    # sliding window un-latch the controller once fast settles arrive.
+    slo2 = SloTracker(
+        histogram=Histogram("serve_slo_trial_recovery", buckets=(1.0,)),
+        window=8,
+    )
+    ctl2 = AdmissionController(1.2, batch_capacity=8, slo=slo2)
+    slo2.observe(30.0)
+    latched_while_backlogged = ctl2.admit(8) == "slo"
+    probe_admitted = ctl2.admit(0) is None
+    for _ in range(8):
+        slo2.observe(0.01)  # probe settles age out the 30s tail
+    recovered = ctl2.admit(16) is None
     return {
         "trial": "serve-slo-admission",
         "fired": {},
@@ -796,6 +813,8 @@ def _serve_slo_trial():
         "shed_on_deep_queue": shed_deep,
         "quarantined_sheds_earlier": shed_rung0 is None
         and shed_rung1 == "slo",
+        "shed_recovers_after_probe": latched_while_backlogged
+        and probe_admitted and recovered,
     }
 
 
@@ -916,7 +935,8 @@ def _problems(report: dict) -> list:
                     "drained_clean", "no_unsettled_tickets",
                     "explicit_reject_after_close", "admit_cold_start",
                     "admit_shallow", "shed_on_deep_queue",
-                    "quarantined_sheds_earlier"):
+                    "quarantined_sheds_earlier",
+                    "shed_recovers_after_probe"):
             if t.get(key) is False:
                 probs.append(f"{t['trial']}: {key} is False")
     ov = report["overhead"]

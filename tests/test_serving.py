@@ -55,7 +55,8 @@ def _items(n=4, bad_first=True):
     return out
 
 
-# -- Histogram.quantile (the shedding signal's foundation) ------------
+# -- Histogram.quantile (export-side estimate; admission reads the
+# -- SloTracker sample window instead) --------------------------------
 
 
 def test_histogram_quantile_empty_is_none():
@@ -165,17 +166,46 @@ def test_queue_cancel_all_returns_everything():
 
 
 def test_slo_tracker_publishes_quantile_gauges():
+    """Quantiles are exact order statistics over the sample window (the
+    histogram is an export sink only), published as gauges."""
     h = Histogram("t_serv_slo_gauges", buckets=(0.1, 0.5, 1.0))
     slo = SloTracker(histogram=h)
     for _ in range(50):
         slo.observe(0.05)
     for _ in range(50):
         slo.observe(0.7)
-    assert slo.quantile(0.5) == 0.1
-    assert slo.quantile(0.99) == 1.0
+    assert slo.quantile(0.5) == 0.05
+    assert slo.quantile(0.99) == 0.7
     g = get_registry().get("consensus_serving_slo_seconds")
-    assert g.value(q="p50") == 0.1
-    assert g.value(q="p99") == 1.0
+    assert g.value(q="p50") == 0.05
+    assert g.value(q="p99") == 0.7
+    # The export histogram was fed every observation (its own quantile
+    # stays the conservative bucket edge — export-only, never read back).
+    assert h.quantile(0.5) == 0.1
+
+
+def test_slo_tracker_window_ages_out_slow_tail():
+    """A burst of slow batches (cold compile) must stop dominating p99
+    once `window` fresh samples have settled — the recovery property
+    the admission controller depends on."""
+    slo = SloTracker(histogram=Histogram("t_serv_slo_window",
+                                         buckets=(1.0,)), window=8)
+    slo.observe(30.0)  # way past every bucket edge
+    assert slo.quantile(0.99) == 30.0
+    for _ in range(8):
+        slo.observe(0.01)
+    assert slo.quantile(0.99) == 0.01  # the 30s sample aged out
+
+
+def test_slo_trackers_are_isolated_per_instance():
+    """Two default trackers share only the export histogram: one slow
+    instance's tail must not leak into the other's admission signal."""
+    slow, fresh = SloTracker(), SloTracker()
+    slow.observe(30.0)
+    assert slow.quantile(0.99) == 30.0
+    assert fresh.quantile(0.99) is None  # still cold
+    with pytest.raises(ValueError):
+        SloTracker(window=0)
 
 
 def test_admission_cold_start_always_admits():
@@ -189,31 +219,46 @@ def test_admission_sheds_on_projected_queue_wait():
     slo = SloTracker(histogram=Histogram("t_serv_adm_shed",
                                          buckets=(0.1, 0.5, 1.0)))
     for _ in range(50):
-        slo.observe(0.4)  # p99 -> 0.5
+        slo.observe(0.5)  # p99 -> 0.5
     adm = AdmissionController(1.2, batch_capacity=8, slo=slo)
-    # 0 queued: 1 batch ahead, 0.5s projected <= 1.2s budget -> admit.
-    assert adm.admit(0) is None
-    # 17 queued: 3 batches ahead, 1.5s projected > 1.2s -> shed.
+    # 4 ahead: 1 batch, 0.5s projected <= 1.2s budget -> admit.
+    assert adm.admit(4) is None
+    # 17 ahead: 3 batches, 1.5s projected > 1.2s -> shed.
     assert adm.admit(17) == SHED_SLO
+
+
+def test_admission_empty_backlog_probes_through_slow_tail():
+    """The no-recovery latch must be impossible: even when p99 dwarfs
+    the budget (cold compile slower than the SLO), an empty backlog
+    admits — that probe's settle is what refreshes the estimate."""
+    slo = SloTracker(histogram=Histogram("t_serv_adm_probe",
+                                         buckets=(1.0,)), window=4)
+    slo.observe(30.0)  # one batch blew way past the 2s-style budget
+    adm = AdmissionController(2.0, batch_capacity=8, slo=slo)
+    assert adm.admit(1) == SHED_SLO   # anything ahead: shed
+    assert adm.admit(0) is None       # nothing ahead: probe admitted
+    for _ in range(4):
+        slo.observe(0.01)             # probes settle fast; tail ages out
+    assert adm.admit(17) is None      # full recovery, deep queue admits
 
 
 def test_admission_quarantined_mesh_sheds_earlier():
     slo = SloTracker(histogram=Histogram("t_serv_adm_ladder",
                                          buckets=(0.1, 0.5, 1.0)))
     for _ in range(50):
-        slo.observe(0.4)
+        slo.observe(0.4)  # p99 -> 0.4
     ladder = Ladder(("pallas", "xla", "host"), "serv-adm-test")
     adm = AdmissionController(1.2, batch_capacity=8, slo=slo,
                               ladder=ladder)
     assert adm.deadline_budget_s() == 1.2
-    assert adm.admit(8) is None  # 2 batches * 0.5 = 1.0 <= 1.2
+    assert adm.admit(8) is None  # 2 batches * 0.4 = 0.8 <= 1.2
     # Demote to the xla rung: budget halves, same depth now sheds.
     ladder.report("pallas", ok=False)
     ladder.report("pallas", ok=False)
     assert ladder.current == "xla"
     assert adm.deadline_budget_s() == pytest.approx(0.6)
     assert adm.admit(8) == SHED_SLO
-    assert adm.admit(0) is None  # shallow queue still admitted
+    assert adm.admit(0) is None  # empty backlog still admitted
 
 
 def test_admission_rejects_bad_config():
