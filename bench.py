@@ -120,6 +120,8 @@ def run_stream(chunk: int, depth: int, batches: int) -> None:
     seq_wall, pipe_wall = min(seq_walls), min(pipe_walls)
     print(f"phases: {verifier.phases.report()}", file=sys.stderr)
 
+    from bitcoinconsensus_tpu.obs import perf
+
     total = batches * cap
     print(
         json.dumps(
@@ -136,6 +138,7 @@ def run_stream(chunk: int, depth: int, batches: int) -> None:
                 "single_shot_latency_s": round(best_lat, 6),
                 "sequential_wall_s": round(seq_wall, 6),
                 "stream_wall_s": round(pipe_wall, 6),
+                "provenance": perf.provenance(),
             }
         )
     )
@@ -197,6 +200,8 @@ def main() -> None:
     assert res.all()
     print(f"phases: {verifier.phases.report()}", file=sys.stderr)
 
+    from bitcoinconsensus_tpu.obs import perf
+
     best = min(times)
     median = sorted(times)[len(times) // 2]
     value = BATCH / best
@@ -210,6 +215,9 @@ def main() -> None:
                 "vs_baseline": round(value / TARGET, 4),
                 "median": round(med_value, 1),
                 "median_vs_baseline": round(med_value / TARGET, 4),
+                # Which hardware/software produced this number — a CPU
+                # container figure can no longer masquerade as a v5e one.
+                "provenance": perf.provenance(),
             }
         )
     )

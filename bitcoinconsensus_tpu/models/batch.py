@@ -50,6 +50,7 @@ from ..core.tx import Tx, TxOut
 from ..crypto.jax_backend import SigCheck, TpuSecpVerifier, default_verifier
 from .. import native_bridge
 from ..obs import counter as _obs_counter
+from ..obs import gauge as _obs_gauge
 from ..obs import histogram as _obs_histogram
 from ..obs import span as _span
 from ..resilience import faults as _faults
@@ -80,6 +81,11 @@ _BATCH_RESULTS = _obs_counter(
     "consensus_batch_results_total",
     "verify_batch results by outcome",
     ("outcome",),
+)
+_STREAM_WINDOW = _obs_gauge(
+    "consensus_pipeline_stream_window",
+    "stream handles concurrently in flight in verify_batch_stream "
+    "(begun, not yet finished) — the pipeline's realized overlap depth",
 )
 _FIXPOINT_ROUNDS = _obs_histogram(
     "consensus_fixpoint_rounds",
@@ -790,10 +796,13 @@ def verify_batch_stream(
     try:
         for items in batches:
             window.append(_begin(items))
+            _STREAM_WINDOW.set(len(window))
             while len(window) >= depth:
                 yield _finish(window.pop(0))
+                _STREAM_WINDOW.set(len(window))
         while window:
             yield _finish(window.pop(0))
+            _STREAM_WINDOW.set(len(window))
     finally:
         # Consumer closed the generator mid-stream (GeneratorExit lands
         # at a yield above): begun batches still hold in-flight device

@@ -22,6 +22,12 @@ Three pieces:
 - ``exposition`` — Prometheus-text and JSON renderings of a snapshot,
   plus snapshot validation/diff helpers for the CLI
   (`scripts/consensus_stats.py`) and the CI `obs-smoke` artifact.
+- ``perf`` — the performance observatory: `PhaseTimeline` phase
+  attribution riding every in-flight dispatch ticket
+  (`consensus_pipeline_phase_seconds{phase=...}` + the
+  overlap-efficiency gauge), the reusable roofline/cost walk shared by
+  the perf scripts, and provenance-stamped report comparison for the CI
+  `perf-smoke` regression gate (`scripts/consensus_perf.py`).
 
 Design constraint (hard): nothing in this package is ever imported by —
 or traced into — device kernel code. Instrumentation is host-side only,
@@ -41,7 +47,18 @@ from .metrics import (
     get_registry,
     histogram,
 )
-from .spans import JsonlSink, Span, add_sink, monotonic, remove_sink, span
+from .spans import (
+    JsonlSink,
+    Span,
+    add_sink,
+    current_span_id,
+    current_trace,
+    monotonic,
+    remove_sink,
+    span,
+    trace_context,
+)
+from . import perf
 
 __all__ = [
     "JsonlSink",
@@ -49,10 +66,14 @@ __all__ = [
     "Span",
     "add_sink",
     "counter",
+    "current_span_id",
+    "current_trace",
     "gauge",
     "get_registry",
     "histogram",
     "monotonic",
+    "perf",
     "remove_sink",
     "span",
+    "trace_context",
 ]

@@ -21,6 +21,13 @@ raised. With no sink attached that aggregation is the ONLY exit-path work
 Attach a `JsonlSink` (or anything with a `write(record: dict)` method) to
 additionally stream one JSON line per span.
 
+Traces cross threads explicitly: every span carries a `trace` id (the
+root span's id, inherited down the per-thread stack), and
+`trace_context(trace, parent_span_id)` adopts a trace begun elsewhere —
+a worker thread wraps its work in the submitting request's context, so
+the JSONL tree no longer breaks at the thread boundary (the serving
+layer's submit→coalesce→burst-worker→settle path rides this).
+
 This module is the one sanctioned clock reader of the pipeline: the host
 AST lint (`analysis/host_lint.py`) rejects direct `time.perf_counter()`
 timing in `models/` and `crypto/` so all timing flows through here, and
@@ -39,7 +46,17 @@ from typing import IO, Optional, Tuple, Union
 
 from .metrics import counter, histogram
 
-__all__ = ["Span", "JsonlSink", "add_sink", "monotonic", "remove_sink", "span"]
+__all__ = [
+    "Span",
+    "JsonlSink",
+    "add_sink",
+    "current_span_id",
+    "current_trace",
+    "monotonic",
+    "remove_sink",
+    "span",
+    "trace_context",
+]
 
 _SPAN_SECONDS = histogram(
     "consensus_span_duration_seconds",
@@ -81,43 +98,82 @@ _sinks_lock = threading.Lock()
 class Span:
     """One timed region. `duration_s` is set when the region exits."""
 
-    __slots__ = ("name", "span_id", "parent_id", "t0", "duration_s", "attrs",
-                 "error")
+    __slots__ = ("name", "span_id", "parent_id", "trace", "t0", "duration_s",
+                 "attrs", "error")
 
     def __init__(self, name: str, span_id: int, parent_id: Optional[int],
-                 attrs: Optional[dict]):
+                 attrs: Optional[dict], trace: Optional[int] = None):
         self.name = name
         self.span_id = span_id
         self.parent_id = parent_id
+        # Root spans define their own trace; children inherit it, and
+        # trace_context() lets another thread adopt it.
+        self.trace = span_id if trace is None else trace
         self.t0 = 0.0
         self.duration_s: Optional[float] = None
         self.attrs = attrs
         self.error: Optional[str] = None
 
 
-class JsonlSink:
-    """Append-mode JSON-lines span sink (one dict per line), thread-safe."""
+class _TraceMarker:
+    """Stack entry standing in for a parent span that lives on another
+    thread: carries only the identity a child needs (parent id + trace).
+    Never timed, never written to sinks."""
 
-    def __init__(self, path_or_file: Union[str, IO[str]]):
+    __slots__ = ("span_id", "trace")
+
+    def __init__(self, span_id: Optional[int], trace: Optional[int]):
+        self.span_id = span_id
+        self.trace = trace
+
+
+class JsonlSink:
+    """Append-mode JSON-lines span sink (one dict per line), thread-safe.
+
+    Flush behavior is bounded: at most `flush_every` records are ever
+    buffered (perf workloads stream tens of thousands of spans; an
+    unbounded libc buffer loses an arbitrary tail on a crash). `close()`
+    is idempotent; a `write()` after close raises — the span exit path
+    counts it in `consensus_obs_sink_errors_total` instead of crashing
+    the verify, so a sink removed late shows up in triage, not as data
+    silently appended to a dead handle.
+    """
+
+    def __init__(self, path_or_file: Union[str, IO[str]],
+                 flush_every: int = 512):
         if isinstance(path_or_file, str):
             self._fh = open(path_or_file, "a", encoding="utf-8")
             self._owns = True
         else:
             self._fh = path_or_file
             self._owns = False
+        self._flush_every = max(1, int(flush_every))
+        self._unflushed = 0
+        self._closed = False
         self._lock = threading.Lock()
 
     def write(self, record: dict) -> None:
         line = json.dumps(record, separators=(",", ":"), sort_keys=True)
         with self._lock:
+            if self._closed:
+                raise ValueError("write() on a closed JsonlSink")
             self._fh.write(line + "\n")
+            self._unflushed += 1
+            if self._unflushed >= self._flush_every:
+                self._fh.flush()
+                self._unflushed = 0
 
     def flush(self) -> None:
         with self._lock:
-            self._fh.flush()
+            if not self._closed:
+                self._fh.flush()
+                self._unflushed = 0
 
     def close(self) -> None:
         with self._lock:
+            if self._closed:
+                return
+            self._closed = True
             self._fh.flush()
             if self._owns:
                 self._fh.close()
@@ -143,6 +199,41 @@ def _stack() -> list:
     return st
 
 
+def current_trace() -> Optional[int]:
+    """Trace id of the innermost open span (or adopted context) on this
+    thread; None outside any span. Hand this (plus the span id) to work
+    you queue onto another thread, and re-enter it there with
+    `trace_context` so the settle side stitches back to the submit side."""
+    st = getattr(_tls, "stack", None)
+    return st[-1].trace if st else None
+
+
+def current_span_id() -> Optional[int]:
+    """Span id of the innermost open span on this thread, or None."""
+    st = getattr(_tls, "stack", None)
+    return st[-1].span_id if st else None
+
+
+@contextmanager
+def trace_context(trace: Optional[int], parent_span_id: Optional[int] = None):
+    """Adopt a trace begun on another thread.
+
+    Spans opened inside the context inherit `trace` and (for top-level
+    ones) parent to `parent_span_id` — the cross-thread stitch: capture
+    `(span.trace, span.span_id)` where the request is submitted, then
+    wrap the worker-side settle in `trace_context(trace, span_id)`.
+    Nests freely with real spans and other contexts; the innermost wins.
+    No timing, no sink record — identity only.
+    """
+    stack = _stack()
+    marker = _TraceMarker(parent_span_id, trace)
+    stack.append(marker)
+    try:
+        yield
+    finally:
+        stack.pop()
+
+
 @contextmanager
 def span(name: str, **attrs):
     """Time a region as `name`; nest freely; yields the live Span.
@@ -159,6 +250,7 @@ def span(name: str, **attrs):
         next(_ids),
         parent.span_id if parent is not None else None,
         attrs or None,
+        trace=parent.trace if parent is not None else None,
     )
     stack.append(sp)
     sp.t0 = time.perf_counter()
@@ -180,6 +272,7 @@ def span(name: str, **attrs):
                 "name": name,
                 "span_id": sp.span_id,
                 "parent_id": sp.parent_id,
+                "trace": sp.trace,
                 "thread": threading.get_ident(),
                 "pid": os.getpid(),
                 "t0": round(sp.t0, 9),

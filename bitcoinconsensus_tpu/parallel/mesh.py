@@ -496,7 +496,8 @@ class ShardedSecpVerifier(TpuSecpVerifier):
             )
         elapsed = _monotonic() - ticket.born
         ok_v, needs_v, bad = self._check_shards(
-            ok_np, needs_np, cnts_np, wsums_np, layout, elapsed
+            ok_np, needs_np, cnts_np, wsums_np, layout, elapsed,
+            timeline=ticket.timeline,
         )
         # Per-device health feeds the eviction ladder at the PRIMARY
         # settle only (re-dispatch retries must not double-convict).
@@ -552,7 +553,8 @@ class ShardedSecpVerifier(TpuSecpVerifier):
         return ok_r, needs_r, None
 
     def _check_shards(self, ok_np, needs_np, cnts_np, wsums_np,
-                      layout: _ShardLayout, elapsed: float):
+                      layout: _ShardLayout, elapsed: float,
+                      timeline=None):
         """Validate each shard's verdict slice independently.
 
         Returns `(ok, needs, bad)` where ok/needs are padded bool buffers
@@ -561,6 +563,9 @@ class ShardedSecpVerifier(TpuSecpVerifier):
         structural validation, then the per-shard checksum (so a
         single-lane flip always convicts as "checksum" — the chaos
         sweep's hard criterion), then the shard's rotating sentinel.
+        `timeline` (the settling ticket's PhaseTimeline, when present)
+        receives one stamp per shard so the perf observatory can
+        attribute settle time shard-by-shard.
         """
         shard = layout.shard_size
         ok_v = np.zeros(layout.padded, dtype=bool)
@@ -605,6 +610,11 @@ class ShardedSecpVerifier(TpuSecpVerifier):
             else:
                 ok_v[sl] = ok_s
                 needs_v[sl] = needs_s
+            finally:
+                # Completion stamp: consecutive deltas (from settle_start)
+                # are this shard's check duration.
+                if timeline is not None:
+                    timeline.stamp_shard(s)
         return ok_v, needs_v, bad
 
     # --- shard re-dispatch ---------------------------------------------

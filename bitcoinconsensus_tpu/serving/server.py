@@ -52,6 +52,8 @@ from ..models.batch import (
 from ..obs import counter as _obs_counter
 from ..obs import histogram as _obs_histogram
 from ..obs import monotonic as _monotonic
+from ..obs import span as _span
+from ..obs import trace_context as _trace_context
 from .queue import CoalescingQueue, QueueClosed, TenantQueueFull
 from .shedding import (
     SHED_CLOSED,
@@ -117,12 +119,19 @@ class OverloadError(ConsensusError):
 class PendingVerify:
     """Future for one admitted request; resolved by the worker thread."""
 
-    __slots__ = ("item", "tenant", "enqueued", "_event", "_result", "_error")
+    __slots__ = ("item", "tenant", "enqueued", "trace", "submit_span",
+                 "_event", "_result", "_error")
 
     def __init__(self, item: BatchItem, tenant: str, enqueued: float):
         self.item = item
         self.tenant = tenant
         self.enqueued = enqueued
+        # Captured at submit: the request's trace id and submit span id.
+        # The worker thread re-enters them (obs.trace_context) at settle,
+        # so the settle span parents back to the submit span across the
+        # thread boundary instead of starting an orphan tree.
+        self.trace: Optional[int] = None
+        self.submit_span: Optional[int] = None
         self._event = threading.Event()
         self._result: Optional[BatchResult] = None
         self._error: Optional[BaseException] = None
@@ -261,20 +270,27 @@ class VerifyServer:
         """Admit one request or raise `OverloadError` immediately."""
         if self._closing or self._closed or self._thread is None:
             raise self._shed(SHED_CLOSED)
-        # Admission projects wait over the FULL backlog — queued plus
-        # the batches already in flight in the stream window; queued
-        # count alone would undersell the wait by up to depth * p99.
-        reason = self.admission.admit(self.pending)
-        if reason is not None:
-            raise self._shed(reason)
-        req = PendingVerify(item, tenant, _monotonic())
-        try:
-            self._queue.put(req)
-        except TenantQueueFull:
-            raise self._shed(SHED_TENANT_FULL) from None
-        except QueueClosed:
-            raise self._shed(SHED_CLOSED) from None
-        _ADMITTED.inc(tenant=tenant)
+        # The submit span roots (or joins) this request's trace; its
+        # (trace, span_id) ride the PendingVerify across the coalescing
+        # queue so the worker-thread settle span stitches back to it.
+        # Sheds raise inside the span and are recorded on it as errors.
+        with _span("serving.submit", tenant=tenant) as sp:
+            # Admission projects wait over the FULL backlog — queued plus
+            # the batches already in flight in the stream window; queued
+            # count alone would undersell the wait by up to depth * p99.
+            reason = self.admission.admit(self.pending)
+            if reason is not None:
+                raise self._shed(reason)
+            req = PendingVerify(item, tenant, _monotonic())
+            req.trace = sp.trace
+            req.submit_span = sp.span_id
+            try:
+                self._queue.put(req)
+            except TenantQueueFull:
+                raise self._shed(SHED_TENANT_FULL) from None
+            except QueueClosed:
+                raise self._shed(SHED_CLOSED) from None
+            _ADMITTED.inc(tenant=tenant)
         return req
 
     def verify(
@@ -351,20 +367,26 @@ class VerifyServer:
                     self._inflight_reqs += len(reqs)
 
         current: Optional[list] = None
+        # The burst leader's trace contexts the driver's own spans (and
+        # the dispatch tickets' timelines) on this worker thread; each
+        # request additionally gets a settle span inside its OWN trace,
+        # parented to its submit span — the cross-thread stitch.
+        leader = first[0]
         try:
-            for out in verify_batch_stream(
-                batches(),
-                self._verifier,
-                self._sig_cache,
-                self._script_cache,
-                depth=self.depth,
-            ):
-                current, flushed = inflight.popleft()
-                self.slo.observe(_monotonic() - flushed)
-                for req, res in zip(current, out, strict=True):
-                    req._resolve(res)
-                self._inflight_reqs -= len(current)
-                current = None
+            with _trace_context(leader.trace, leader.submit_span):
+                for out in verify_batch_stream(
+                    batches(),
+                    self._verifier,
+                    self._sig_cache,
+                    self._script_cache,
+                    depth=self.depth,
+                ):
+                    current, flushed = inflight.popleft()
+                    self.slo.observe(_monotonic() - flushed)
+                    for req, res in zip(current, out, strict=True):
+                        self._settle_one(req, res)
+                    self._inflight_reqs -= len(current)
+                    current = None
         except BaseException as exc:
             # Explicit failure, never a hang: the popped batch (partially
             # resolved at most) and every batch still windowed.
@@ -382,6 +404,14 @@ class VerifyServer:
                 for req in reqs:
                     req._fail(exc)
                 self._inflight_reqs -= len(reqs)
+
+    def _settle_one(self, req: PendingVerify, res) -> None:
+        """Resolve one request under its own trace: the settle span
+        parents to the request's submit span (captured on the submitting
+        thread), so JSONL trees survive the worker-thread hop."""
+        with _trace_context(req.trace, req.submit_span):
+            with _span("serving.settle", tenant=req.tenant):
+                req._resolve(res)
 
     def _note_flush(self, reqs: list) -> float:
         now = _monotonic()

@@ -1,0 +1,293 @@
+"""Performance observatory CLI: workload + phase attribution + roofline
++ provenance, with a regression gate against the checked-in trajectory.
+
+Runs a small all-unique mixed verify workload through the real pipeline
+(TpuSecpVerifier -> in-flight queue -> settle guards), reads the phase
+histograms the PhaseTimelines populated, rooflines every registered
+kernel, and emits one machine-readable report:
+
+    {round, workload{batch, iters, best_s, verifies_per_sec},
+     phases{phase: {count, mean_s, total_s}}, overlap_efficiency,
+     kernels[...], overhead?, provenance{platform, device_kind, ...}}
+
+`--check` compares against the highest-numbered PERF_r{N}.json in the
+repo root and EXITS NONZERO on regression beyond tolerance — unless the
+provenance is not comparable (different platform/device kind), in which
+case the comparison is explicitly skipped: a CPU container run can never
+fail a TPU baseline (the BENCH_r06 footgun, closed).
+
+    JAX_PLATFORMS=cpu python scripts/consensus_perf.py --out PERF_ci.json --check
+    python scripts/consensus_perf.py --batch 4096 --out PERF_r08.json   # on TPU
+
+`--inject-prepare-sleep S` wraps the verifier's prepare callback with a
+sleep — the self-test that the gate actually catches a prepare-phase
+slowdown. `--overhead-trials K` additionally measures the disarmed-path
+stamp overhead (chaos-style accounting: events x microbenchmarked no-op
+cost vs measured wall) and fails above 1 %.
+"""
+
+import argparse
+import hashlib
+import json
+import os
+import re
+import sys
+import time
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+DEFAULT_BATCH = 512
+DEFAULT_ITERS = 3
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _build_checks(batch):
+    from bitcoinconsensus_tpu.crypto import secp_host as H
+    from bitcoinconsensus_tpu.crypto.jax_backend import SigCheck
+
+    checks = []
+    for i in range(batch):
+        sk = (i * 2654435761 + 424242) % (H.N - 1) + 1
+        msg = hashlib.sha256(b"perf-%d" % i).digest()
+        if i % 3 == 2:
+            xpk, _ = H.xonly_pubkey_create(sk)
+            checks.append(
+                SigCheck("schnorr", (xpk, H.sign_schnorr(sk, msg), msg))
+            )
+        else:
+            pub = H.pubkey_create(sk, compressed=bool(i % 2))
+            checks.append(
+                SigCheck("ecdsa", (pub, H.sign_ecdsa(sk, msg), msg))
+            )
+    return checks
+
+
+def _register_kernels():
+    """Register the dispatchable kernels with the perf module. The XLA
+    complete-add kernel always; the pallas fast-add kernel only where it
+    can actually run compiled (TPU)."""
+    import jax
+    import numpy as np
+
+    from bitcoinconsensus_tpu.obs import perf
+
+    def _synthetic_args(n):
+        rng = np.random.default_rng(3)
+        fields = rng.integers(0, 256, size=(n, 4, 32), dtype=np.uint8)
+        zeros = np.zeros(n, np.int32)
+        return (
+            fields, zeros, np.full(n, -1, np.int32), zeros.copy(),
+            zeros.copy(), zeros.copy(), np.ones(n, bool),
+        )
+
+    def make_xla():
+        from bitcoinconsensus_tpu.crypto.jax_backend import _verify_kernel
+
+        n = 1024
+        args = tuple(jax.device_put(a) for a in _synthetic_args(n))
+        return jax.jit(_verify_kernel), args, _verify_kernel, args
+
+    perf.register_kernel("verify_xla", make_xla)
+
+    if jax.default_backend() == "tpu":
+        def make_pallas():
+            from functools import partial
+
+            from bitcoinconsensus_tpu.ops.pallas_kernel import (
+                LANE_TILE,
+                verify_tiles,
+            )
+
+            n = max(LANE_TILE * 8, 1024 // LANE_TILE * LANE_TILE)
+            args = tuple(jax.device_put(a) for a in _synthetic_args(n))
+            # Trace ONE tile interpreted (the grid repeats one program);
+            # time the full compiled grid.
+            trace = partial(verify_tiles, tile=LANE_TILE, interpret=True)
+            targs = tuple(a[:LANE_TILE] for a in args)
+            return verify_tiles, args, trace, targs
+
+        perf.register_kernel("verify_tiles_pallas", make_pallas)
+
+
+def _run_workload(verifier, checks, iters):
+    from bitcoinconsensus_tpu.obs import monotonic
+
+    res = verifier.verify_checks(checks)  # compile + warmup
+    assert res.all(), "workload checks must all verify"
+    best = None
+    for _ in range(max(1, iters)):
+        t0 = monotonic()
+        verifier.verify_checks(checks)
+        dt = monotonic() - t0
+        best = dt if best is None or dt < best else best
+    return best
+
+
+def _overhead_budget(verifier, checks, trials):
+    """Disarmed-path stamp overhead, chaos-style accounting: events per
+    run x microbenchmarked no-op stamp cost, vs the measured wall. The
+    bound is an overestimate (every hook costed at the full call price),
+    so passing it is conservative."""
+    from bitcoinconsensus_tpu.obs import monotonic, perf
+
+    was = perf.timeline_enabled()
+    perf.set_enabled(False)
+    try:
+        wall = min(
+            _run_workload(verifier, checks, 1) for _ in range(max(1, trials))
+        )
+    finally:
+        perf.set_enabled(was)
+    # ~6 lifecycle stamps + finalize + new_timeline per dispatch; chunked
+    # dispatch means ceil(batch / lane_capacity) tickets per run.
+    tickets = -(-len(checks) // verifier.lane_capacity)
+    events = tickets * 8
+    nt = perf.NULL_TIMELINE
+    reps = 100_000
+    t0 = monotonic()
+    for _ in range(reps):
+        nt.stamp("x")
+    per_call = (monotonic() - t0) / reps
+    spent = events * per_call
+    return {
+        "trials": trials,
+        "wall_s": round(wall, 6),
+        "disarmed_events": events,
+        "per_event_s": per_call,
+        "bound_s": spent,
+        "bound_pct": round(100.0 * spent / wall, 5) if wall > 0 else 0.0,
+        "ok": spent < 0.01 * wall,
+    }
+
+
+def _find_baseline(exclude):
+    best_n, best_path = -1, None
+    pat = re.compile(r"^PERF_r(\d+)\.json$")
+    for name in os.listdir(ROOT):
+        m = pat.match(name)
+        path = os.path.join(ROOT, name)
+        if m and os.path.abspath(path) != os.path.abspath(exclude or ""):
+            n = int(m.group(1))
+            if n > best_n:
+                best_n, best_path = n, path
+    return best_path
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--batch", type=int, default=DEFAULT_BATCH)
+    ap.add_argument("--iters", type=int, default=DEFAULT_ITERS)
+    ap.add_argument("--reps", type=int, default=5,
+                    help="kernel roofline timing repetitions")
+    ap.add_argument("--out", default=None, help="write the report here")
+    ap.add_argument("--check", action="store_true",
+                    help="regression-gate against the newest PERF_r{N}.json")
+    ap.add_argument("--tolerance", type=float, default=0.5,
+                    help="relative regression tolerance for --check")
+    ap.add_argument("--inject-prepare-sleep", type=float, default=0.0,
+                    metavar="S", help="slow the prepare phase (gate self-test)")
+    ap.add_argument("--overhead-trials", type=int, default=0, metavar="K",
+                    help="measure disarmed-path stamp overhead; fail above 1%%")
+    ap.add_argument("--skip-kernels", action="store_true",
+                    help="skip the per-kernel roofline reports (the gate "
+                    "compares phases and throughput only, so quick --check "
+                    "runs don't need the kernel timing legs)")
+    args = ap.parse_args()
+
+    from bitcoinconsensus_tpu.crypto.jax_backend import TpuSecpVerifier
+    from bitcoinconsensus_tpu.obs import get_registry, perf
+
+    t0 = time.time()
+    checks = _build_checks(args.batch)
+    print(f"built {args.batch} unique checks in {time.time() - t0:.1f}s",
+          file=sys.stderr)
+
+    verifier = TpuSecpVerifier()
+    if args.inject_prepare_sleep > 0.0:
+        q = verifier._inflight
+        orig, delay = q._prepare, args.inject_prepare_sleep
+
+        def slow_prepare(a, n):
+            time.sleep(delay)
+            return orig(a, n)
+
+        q._prepare = slow_prepare
+
+    get_registry().reset()
+    perf.reset_overlap_window()
+    best = _run_workload(verifier, checks, args.iters)
+
+    kernels = []
+    if not args.skip_kernels:
+        _register_kernels()
+    for name, make in sorted(perf.registered_kernels().items()):
+        try:
+            made = make()
+            run, run_args, trace_fn, trace_args = made
+            kernels.append(perf.kernel_report(
+                name, run, run_args,
+                trace_fn=trace_fn, trace_args=trace_args, reps=args.reps,
+            ))
+        except Exception as exc:  # a missing backend is a note, not a crash
+            kernels.append({"kernel": name, "error": f"{type(exc).__name__}: {exc}"})
+
+    report = {
+        "workload": {
+            "batch": args.batch,
+            "iters": args.iters,
+            "best_s": round(best, 6),
+            "verifies_per_sec": round(args.batch / best, 1),
+        },
+        "phases": perf.phase_report(),
+        "overlap_efficiency": perf.overlap_efficiency(),
+        "kernels": kernels,
+        "provenance": perf.provenance(),
+    }
+
+    status = 0
+    if args.overhead_trials > 0:
+        budget = _overhead_budget(verifier, checks, args.overhead_trials)
+        report["overhead"] = budget
+        if not budget["ok"]:
+            print(f"FAIL: disarmed stamp overhead bound "
+                  f"{budget['bound_pct']:.3f}% >= 1%", file=sys.stderr)
+            status = 1
+
+    if args.check:
+        baseline_path = _find_baseline(exclude=args.out)
+        if baseline_path is None:
+            print("check: no PERF_r{N}.json baseline found — skipping",
+                  file=sys.stderr)
+        else:
+            with open(baseline_path) as fh:
+                baseline = json.load(fh)
+            problems = perf.compare_reports(
+                baseline, report, tolerance=args.tolerance
+            )
+            if problems is None:
+                ok, why = False, perf.comparable(
+                    baseline.get("provenance", {}), report["provenance"]
+                )[1]
+                print(f"check: provenance not comparable ({why}) — "
+                      f"skipping vs {os.path.basename(baseline_path)}",
+                      file=sys.stderr)
+            elif problems:
+                for p in problems:
+                    print(f"FAIL: {p}", file=sys.stderr)
+                print(f"check: {len(problems)} regression(s) vs "
+                      f"{os.path.basename(baseline_path)}", file=sys.stderr)
+                status = 1
+            else:
+                print(f"check: OK vs {os.path.basename(baseline_path)}",
+                      file=sys.stderr)
+
+    print(json.dumps(report, indent=2))
+    if args.out:
+        with open(args.out, "w") as fh:
+            json.dump(report, fh, indent=2)
+            fh.write("\n")
+    return status
+
+
+if __name__ == "__main__":
+    sys.exit(main())

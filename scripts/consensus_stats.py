@@ -87,6 +87,12 @@ REQUIRED_METRICS = [
     "consensus_inflight_depth",
     "consensus_inflight_tickets_total",
     "consensus_inflight_settle_seconds",
+    # performance observatory (ticket phase timelines settle on every
+    # guarded dispatch; the stream-window gauge sets on the serving leg's
+    # verify_batch_stream bursts)
+    "consensus_pipeline_phase_seconds",
+    "consensus_pipeline_overlap_efficiency",
+    "consensus_pipeline_stream_window",
     # serving front end (admission + coalescing + SLO shedding; the
     # workload's serving leg admits a small fan-in and forces one
     # explicit shed so both sides of the admission decision sample)

@@ -1,22 +1,27 @@
-"""Roofline accounting for the verify kernel: measured throughput vs the
-chip's integer-op ceiling, with the op count taken from the TRACED program
-(no hand-waved estimates).
+"""Roofline accounting for the pallas verify kernel: measured throughput
+vs the chip's integer-op ceiling, with the op count taken from the TRACED
+program (no hand-waved estimates).
 
-- Op count: walk the jaxpr of one `verify_tiles` tile and sum the element
-  counts of every arithmetic/logic/select/compare primitive — the int32
-  work the VPU actually executes (loads/stores and MXU dots excluded).
-- Throughput: min-of-N device-resident timing (the shared chip's
-  throughput swings; min approximates the uncontended kernel).
+Thin wrapper over `bitcoinconsensus_tpu.obs.perf` (the op-walk, timing,
+and provenance helpers live there and are shared with
+`scripts/consensus_perf.py`):
+
+- Op count: walk the jaxpr of ONE `verify_tiles` tile (the pallas grid
+  runs B/tile instances of the same program; fori trip counts recovered
+  from the carry-init literals) and sum arithmetic/logic/select/compare
+  element counts — the int32 work the VPU actually executes.
+- Throughput: min-of-N device-resident timing of the full compiled grid.
 - Ceiling: TPU v5e VPU = (8, 128) vector unit x 4 ALUs at ~0.94 GHz
-  ~= 3.85e12 int32 ops/s (public figures from the scaling-book / v5e
-  specs; MXU FLOPs are irrelevant here — this kernel is VPU-bound).
+  ~= 3.85e12 int32 ops/s (MXU FLOPs are irrelevant — VPU-bound kernel).
 
-Writes KERNEL_r{N}.json when invoked with --out.
+Writes KERNEL_r{N}.json when invoked with --out; every artifact carries
+a provenance block, so the regression gate can refuse cross-hardware
+comparisons instead of trusting filenames.
 """
 
 import json
 import sys
-import time
+from functools import partial
 
 sys.path.insert(0, __file__.rsplit("/", 2)[0])
 
@@ -26,51 +31,9 @@ import jax
 N = 10240
 REPS = 15
 
-ARITH = {
-    "add", "sub", "mul", "and", "or", "xor", "shift_left",
-    "shift_right_logical", "shift_right_arithmetic", "select_n", "eq", "ne",
-    "lt", "le", "gt", "ge", "min", "max", "neg", "abs", "rem", "not",
-    "convert_element_type", "broadcast_in_dim", "concatenate", "iota",
-    "reduce_and", "reduce_or", "reduce_sum", "reduce_min", "reduce_max",
-}
-# Conservative split: data movement / shape ops are NOT compute but still
-# occupy the VPU pipeline; count them separately.
-MOVE = {"convert_element_type", "broadcast_in_dim", "concatenate", "iota"}
-
-
-def _count(jaxpr, mult=1):
-    comp = move = 0
-    for eqn in jaxpr.eqns:
-        prim = eqn.primitive.name
-        if prim in ("pjit", "closed_call", "custom_jvp_call", "custom_vjp_call"):
-            c, m = _count(eqn.params["jaxpr"].jaxpr, mult)
-            comp += c
-            move += m
-            continue
-        if prim == "while":
-            body = eqn.params["body_jaxpr"].jaxpr
-            # trip count not recoverable generically; fori bodies here are
-            # the window loops — extract from the cond bound if constant
-            trips = eqn.params.get("_trips", 1)
-            c, m = _count(body, mult)
-            comp += c * trips
-            move += m * trips
-            continue
-        if prim == "scan":
-            c, m = _count(eqn.params["jaxpr"].jaxpr, mult)
-            trips = eqn.params["length"]
-            comp += c * trips
-            move += m * trips
-            continue
-        outs = sum(int(np.prod(v.aval.shape)) for v in eqn.outvars)
-        if prim in MOVE:
-            move += outs * mult
-        elif prim in ARITH:
-            comp += outs * mult
-    return comp, move
-
 
 def main():
+    from bitcoinconsensus_tpu.obs import perf
     from bitcoinconsensus_tpu.ops.pallas_kernel import LANE_TILE, verify_tiles
 
     rng = np.random.default_rng(3)
@@ -82,110 +45,30 @@ def main():
     n2 = np.zeros(N, np.int32)
     v = np.ones(N, bool)
 
-    # Trace ONE tile's kernel body via interpret-mode jaxpr: the pallas
-    # grid runs B/tile instances of the same program, and the fori_loops
-    # inside carry static trip counts we account for below.
-    import bitcoinconsensus_tpu.ops.pallas_kernel as PK
-    from functools import partial
+    dargs = tuple(jax.device_put(x) for x in (fields, w, par, h2, n1, n2, v))
 
+    # Trace ONE tile's kernel body via interpret-mode jaxpr; time the full
+    # compiled grid. kernel_report scales per-lane ops by the trace's lane
+    # count, so the one-tile trace prices every grid instance.
     T = LANE_TILE
-    closed = jax.make_jaxpr(
-        partial(verify_tiles, tile=T, interpret=True)
-    )(fields[:T], w[:T], par[:T], h2[:T], n1[:T], n2[:T], v[:T])
+    rep = perf.kernel_report(
+        "verify_tiles_pallas",
+        verify_tiles, dargs,
+        trace_fn=partial(verify_tiles, tile=T, interpret=True),
+        trace_args=tuple(a[:T] for a in dargs),
+        reps=REPS,
+    )
 
-    # Walk everything; while-loops (fori) get their trip counts from the
-    # two known loops (window loop = SGLV_WINDOWS, G loop = G_WINDOWS) —
-    # tag by body size ordering instead of guessing: collect per-while
-    # body costs and assign the two largest the known trip counts.
-    from jax._src.core import Literal
-
-    def while_trips(eqn) -> int:
-        """fori_loop lowers to `while` whose carry init holds the (static)
-        upper bound as a scalar int literal — take the largest such
-        literal as the trip count (exact for every fori in this kernel:
-        window loop, G loop, and the _sqr_n chains)."""
-        trips = 1
-        for v in eqn.invars:
-            if isinstance(v, Literal) and getattr(v.aval, "shape", None) == ():
-                try:
-                    trips = max(trips, int(v.val))
-                except (TypeError, ValueError):
-                    pass
-        return trips
-
-    def walk(jaxpr):
-        comp = move = 0
-        for eqn in jaxpr.eqns:
-            prim = eqn.primitive.name
-            if prim == "while":
-                c, m = walk(eqn.params["body_jaxpr"].jaxpr)
-                t = while_trips(eqn)
-                comp += c * t
-                move += m * t
-                continue
-            if prim == "scan":
-                c, m = walk(eqn.params["jaxpr"].jaxpr)
-                comp += c * eqn.params["length"]
-                move += m * eqn.params["length"]
-                continue
-            recursed = False
-            for p in eqn.params.values():
-                # ClosedJaxpr (.jaxpr) or raw Jaxpr (.eqns) — pallas_call
-                # carries the latter.
-                sub = getattr(p, "jaxpr", p if hasattr(p, "eqns") else None)
-                if sub is not None:
-                    c, m = walk(sub)
-                    comp += c
-                    move += m
-                    recursed = True
-            if recursed:
-                continue
-            outs = sum(int(np.prod(vv.aval.shape)) for vv in eqn.outvars)
-            if prim in MOVE:
-                move += outs
-            elif prim in ARITH:
-                comp += outs
-        return comp, move
-
-    comp, move = walk(closed.jaxpr)
-    ops_per_lane = comp / T
-    move_per_lane = move / T
-
-    # Timing: device-resident args, min of REPS.
-    dargs = [jax.device_put(x) for x in (fields, w, par, h2, n1, n2, v)]
-    for x in dargs:
-        x.block_until_ready()
-    ok, needs = verify_tiles(*dargs)
-    np.asarray(ok)
-    times = []
-    for _ in range(REPS):
-        t0 = time.perf_counter()
-        ok, needs = verify_tiles(*dargs)
-        ok.block_until_ready()
-        needs.block_until_ready()
-        times.append(time.perf_counter() - t0)
-    best = min(times)
-    lanes_per_s = N / best
-
-    PEAK = 3.85e12  # v5e VPU int32 ops/s (8x128 lanes x 4 ALUs x 0.94 GHz)
-    achieved = ops_per_lane * lanes_per_s
-    out = {
-        "lanes": N,
-        "tile": T,
-        "best_ms": round(best * 1000, 2),
-        "median_ms": round(sorted(times)[len(times) // 2] * 1000, 2),
-        "lanes_per_sec_best": round(lanes_per_s, 1),
-        "int_ops_per_lane": round(ops_per_lane, 1),
-        "move_ops_per_lane": round(move_per_lane, 1),
-        "achieved_int_ops_per_sec": f"{achieved:.3e}",
-        "vpu_peak_int_ops_per_sec": f"{PEAK:.3e}",
-        "vpu_utilization_pct": round(100 * achieved / PEAK, 1),
-        "note": (
-            "ops counted from the traced kernel jaxpr (arith/logic/select/"
-            "compare element counts); peak assumes v5e VPU 8x128x4 ALUs at "
-            "0.94 GHz; min-of-N timing on the shared chip"
-        ),
-    }
+    # Keep the historical KERNEL_r{N}.json key set (KERNEL_r05 et al.)
+    # alongside the shared-module fields.
+    out = dict(rep)
+    out["tile"] = T
+    out["note"] = (
+        "ops counted from the traced kernel jaxpr (arith/logic/select/"
+        "compare element counts); peak assumes v5e VPU 8x128x4 ALUs at "
+        "0.94 GHz; min-of-N timing on the shared chip"
+    )
+    out["provenance"] = perf.provenance()
     print(json.dumps(out, indent=2))
     if "--out" in sys.argv:
         path = sys.argv[sys.argv.index("--out") + 1]

@@ -139,6 +139,8 @@ def main(argv=None) -> int:
         "continued_bit_identical": cont_ok,
     }
 
+    from bitcoinconsensus_tpu.obs import perf
+
     doc = {
         "n_devices": args.devices,
         "platform": devs[0].platform,
@@ -147,6 +149,7 @@ def main(argv=None) -> int:
         "ok": True,
         "clean": clean,
         "eviction": eviction,
+        "provenance": perf.provenance(),
     }
     out = json.dumps(doc, indent=2)
     if args.out:

@@ -2,7 +2,11 @@
 
 Run on the real chip (no args) or CPU (JAX_PLATFORMS=cpu). All-unique
 signatures — no in-batch dedup flattery. Prints per-phase seconds for a
-BATCH-lane mixed dispatch plus a device-only kernel timing.
+BATCH-lane mixed dispatch plus the pipeline phase histograms the
+in-flight tickets populate (`consensus_pipeline_phase_seconds`), with a
+provenance block so the numbers can never be mistaken for another
+hardware class's. Timing helpers come from
+`bitcoinconsensus_tpu.obs.perf` (shared with consensus_perf.py).
 """
 
 import hashlib
@@ -18,6 +22,7 @@ BATCH = int(sys.argv[1]) if len(sys.argv) > 1 and sys.argv[1].isdigit() else 819
 def main():
     from bitcoinconsensus_tpu.crypto import secp_host as H
     from bitcoinconsensus_tpu.crypto.jax_backend import SigCheck, TpuSecpVerifier
+    from bitcoinconsensus_tpu.obs import perf
 
     t0 = time.time()
     checks = []
@@ -61,6 +66,9 @@ def main():
         "total_secs": round(dt, 4),
         "verifies_per_sec": round(BATCH / dt, 1),
         "phases": rep,
+        "pipeline_phases": perf.phase_report(),
+        "overlap_efficiency": perf.overlap_efficiency(),
+        "provenance": perf.provenance(),
     }, indent=2))
 
 

@@ -112,9 +112,14 @@ def check_production() -> None:
     assert (got == want).all(), np.nonzero(got != want)
     bad = [0, 1, 2, 3, 4, 6, 9, 10, 12]
     assert not want[bad].any(), want[bad]
-    mask = np.ones(LANE_TILE, dtype=bool)
+    # _pack_lanes pads past LANE_TILE (the sentinel reservation means
+    # LANE_TILE real lanes need the next chunk size): only the real-lane
+    # prefix must verify; pad lanes are valid=False and must all fail.
+    mask = np.zeros(want.size, dtype=bool)
+    mask[:LANE_TILE] = True
     mask[bad] = False
     assert want[mask].all(), np.nonzero(~want & mask)
+    assert not want[LANE_TILE:].any(), "pad lanes must not verify"
 
 
 def check_collision() -> None:

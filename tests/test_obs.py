@@ -435,3 +435,123 @@ def _timed(fn):
     t0 = time.perf_counter()
     fn()
     return time.perf_counter() - t0
+
+
+# ---------------------------------------------------------------------------
+# Trace ids + cross-thread stitching (the serving submit->settle seam).
+
+
+def test_root_span_defines_trace_children_inherit():
+    sink = _ListSink()
+    add_sink(sink)
+    try:
+        with span("trace-root") as root:
+            assert root.trace == root.span_id
+            assert S.current_trace() == root.trace
+            assert S.current_span_id() == root.span_id
+            with span("trace-child") as child:
+                assert child.trace == root.trace
+                assert child.trace != child.span_id
+        assert S.current_trace() is None
+        assert S.current_span_id() is None
+    finally:
+        remove_sink(sink)
+    child_rec, root_rec = sink.records
+    assert child_rec["trace"] == root_rec["trace"] == root_rec["span_id"]
+
+
+def test_trace_context_stitches_across_threads():
+    """A span opened on another thread inside `trace_context` must join
+    the originating trace and parent to the handed-over span id — the
+    submit->worker-settle seam, in miniature."""
+    sink = _ListSink()
+    add_sink(sink)
+    handoff = {}
+    try:
+        with span("stitch-submit") as sub:
+            handoff["trace"] = sub.trace
+            handoff["parent"] = sub.span_id
+
+        def worker():
+            with S.trace_context(handoff["trace"], handoff["parent"]):
+                with span("stitch-settle"):
+                    pass
+
+        t = threading.Thread(target=worker)
+        t.start()
+        t.join()
+    finally:
+        remove_sink(sink)
+    by_name = {r["name"]: r for r in sink.records}
+    sub_rec = by_name["stitch-submit"]
+    set_rec = by_name["stitch-settle"]
+    assert set_rec["trace"] == sub_rec["trace"]
+    assert set_rec["parent_id"] == sub_rec["span_id"]
+    assert set_rec["thread"] != sub_rec["thread"]
+
+
+def test_trace_context_nests_and_restores():
+    with S.trace_context(777, 42):
+        assert S.current_trace() == 777
+        assert S.current_span_id() == 42
+        with span("ctx-inner") as sp:
+            assert sp.trace == 777
+            assert sp.parent_id == 42
+    assert S.current_trace() is None
+
+
+# ---------------------------------------------------------------------------
+# JsonlSink under perf-workload volume: bounded flush, idempotent close,
+# write-after-close counted (never crashing the verify).
+
+
+def test_jsonl_sink_bounded_flush():
+    class FlushCountingIO(io.StringIO):
+        def __init__(self):
+            super().__init__()
+            self.flushes = 0
+
+        def flush(self):
+            self.flushes += 1
+            return super().flush()
+
+    buf = FlushCountingIO()
+    sink = JsonlSink(buf, flush_every=4)
+    for i in range(10):
+        sink.write({"i": i})
+    # 10 records / flush_every=4 -> exactly 2 size-triggered flushes; at
+    # most flush_every records are ever buffered.
+    assert buf.flushes == 2
+    sink.close()
+    assert buf.flushes == 3  # close flushes the tail
+    assert len(buf.getvalue().splitlines()) == 10
+
+
+def test_jsonl_sink_close_idempotent_and_write_after_close_raises():
+    buf = io.StringIO()
+    sink = JsonlSink(buf)
+    sink.write({"a": 1})
+    sink.close()
+    sink.close()  # idempotent
+    sink.flush()  # no-op after close, must not raise
+    with pytest.raises(ValueError):
+        sink.write({"b": 2})
+    assert len(buf.getvalue().splitlines()) == 1
+
+
+def test_closed_jsonl_sink_counts_as_sink_error_not_crash():
+    """A JsonlSink closed while still attached must not take down the
+    spans riding it — the dropped records land in
+    `consensus_obs_sink_errors_total{sink=JsonlSink}` for triage."""
+    before = S._SINK_ERRORS.value(sink="JsonlSink")
+    sink = JsonlSink(io.StringIO())
+    add_sink(sink)
+    try:
+        sink.close()  # closed while attached (the late-removal bug)
+        with span("obs-test-closed-sink"):
+            pass  # must not raise
+        with span("obs-test-closed-sink-2"):
+            pass
+    finally:
+        remove_sink(sink)
+    assert S._SINK_ERRORS.value(sink="JsonlSink") == before + 2

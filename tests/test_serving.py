@@ -426,3 +426,50 @@ def test_retry_client_non_shed_errors_propagate():
 
     with pytest.raises(ValueError):
         verify_with_retry(_Broken(), "item", retries=5, backoff_s=0.001)
+
+
+# -- cross-thread trace stitching (the performance observatory's span
+# -- contract: settle parents to submit across the worker thread) ------
+
+
+def test_settle_span_parents_to_submit_span_across_worker_thread():
+    """Every request's `serving.settle` span (emitted on the worker
+    thread) must join the trace its `serving.submit` span rooted and
+    parent directly to it — the JSONL tree no longer breaks at the
+    thread boundary."""
+    from bitcoinconsensus_tpu.obs import add_sink, remove_sink
+
+    class _ListSink:
+        def __init__(self):
+            self.records = []
+
+        def write(self, record):
+            self.records.append(record)
+
+    items = _items(3, bad_first=False)
+    sink = _ListSink()
+    add_sink(sink)
+    try:
+        with VerifyServer(max_batch=4, flush_s=0.005, tenant_depth=16) as srv:
+            pend = [srv.submit(it, tenant=f"t{i}")
+                    for i, it in enumerate(items)]
+            assert all(p.result(timeout=120).ok for p in pend)
+    finally:
+        remove_sink(sink)
+
+    submits = [r for r in sink.records if r["name"] == "serving.submit"]
+    settles = [r for r in sink.records if r["name"] == "serving.settle"]
+    assert len(submits) == len(items)
+    assert len(settles) == len(items)
+    by_span = {r["span_id"]: r for r in submits}
+    for settle in settles:
+        submit = by_span[settle["parent_id"]]  # parents to a submit span
+        assert settle["trace"] == submit["trace"] == submit["span_id"]
+        # settle really ran on the worker thread, not the submitter's
+        assert settle["thread"] != submit["thread"]
+        assert settle["attrs"]["tenant"] == submit["attrs"]["tenant"]
+    # and the driver spans the burst emits join the burst leader's trace
+    driver = [r for r in sink.records
+              if r["name"].startswith("batch.stream_")]
+    leader_traces = {r["trace"] for r in submits}
+    assert driver and all(r["trace"] in leader_traces for r in driver)
