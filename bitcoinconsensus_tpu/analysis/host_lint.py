@@ -256,6 +256,10 @@ def lint_consensus_host(repo_root: str) -> List[LintFinding]:
     findings += lint_paths(
         [os.path.join(pkg, "crypto", "jax_backend.py"),
          os.path.join(pkg, "parallel", "mesh.py"),
-         os.path.join(pkg, "resilience", "inflight.py")],
+         os.path.join(pkg, "resilience", "inflight.py"),
+         # The network edge and the persistent store sit upstream of the
+         # dispatch path: neither may ever force a device buffer to host.
+         os.path.join(pkg, "serving", "ingress.py"),
+         os.path.join(pkg, "models", "sigstore.py")],
         rules=SYNC_RULES)
     return findings

@@ -32,6 +32,13 @@ Sites registered by the pipeline (grep for the literal):
                             probe (keeps the device quarantined)
     batch.dispatch          raise at the batch driver's resolve step
     sigcache.sig            poisoned hit on the signature cache
+    ingress.read            raise on a socket-session frame read (the
+                            session tears down; the listener survives)
+    ingress.write           raise on a socket-session response write
+    sigstore.load           raise during a persistent-store shard replay
+                            (that shard starts cold; contained)
+    sigstore.append         raise on a persistent-store log append (the
+                            entry stays unpersisted; verdicts unaffected)
 
 This module is host-side policy, never consensus; it is linted with the
 clock rule only (`analysis/host_lint.py`) and reads no clocks at all.

@@ -19,15 +19,21 @@ pieces, bottom up:
   cancels) everything admitted and leaves no unsettled ticket.
 - `client.verify_with_retry` — bounded retries with jittered
   exponential backoff for shed requests.
+- `ingress.IngressServer` / `client.IngressClient` — the network edge:
+  length-prefixed binary framing over persistent TCP sessions, read
+  deadlines reaping slow-loris peers, sheds as explicit
+  `ERR_OVERLOADED` frames, protocol errors typed and never retried.
 
-Chaos-gated by `scripts/consensus_chaos.py --serve`: concurrent
+Chaos-gated by `scripts/consensus_chaos.py --serve` (and `--ingress`
+for the socket edge): concurrent
 clients against injected faults plus synthetic overload, requiring
 bit-identical verdicts for every admitted request and an explicit
 reject for every shed one. `scripts/consensus_stats.py` snapshots the
 `consensus_serving_*` metrics; README "Serving" documents the knobs.
 """
 
-from .client import verify_with_retry
+from .client import IngressClient, IngressProtocolError, verify_with_retry
+from .ingress import IngressServer
 from .queue import CoalescingQueue, QueueClosed, TenantQueueFull
 from .server import OverloadError, PendingVerify, VerifyServer
 from .shedding import (
@@ -41,6 +47,9 @@ from .shedding import (
 __all__ = [
     "AdmissionController",
     "CoalescingQueue",
+    "IngressClient",
+    "IngressProtocolError",
+    "IngressServer",
     "OverloadError",
     "PendingVerify",
     "QueueClosed",

@@ -1,4 +1,4 @@
-"""Bounded-retry client path for shed requests.
+"""Client paths: bounded-retry submission, in-process or over a socket.
 
 A shed (`OverloadError`) is a fail-closed reject of work that never
 started, so retrying is always safe — but unbounded synchronized
@@ -6,7 +6,19 @@ retries would just re-create the overload (the classic thundering
 herd). `verify_with_retry` therefore backs off exponentially with
 full jitter (a uniform fraction of the current delay, so colliding
 clients decorrelate) and gives up after a bounded number of attempts,
-re-raising the final `OverloadError` for the caller to surface.
+re-raising the final error for the caller to surface.
+
+`IngressClient` is the wire transport (serving/ingress.py framing)
+with the same error taxonomy the retry loop keys on:
+
+- `OverloadError` — the server said `ERR_OVERLOADED`: retryable.
+- `ConnectionError` — the connection died mid-exchange (server
+  restart, reaped session, network fault): the request may or may not
+  have executed, but verification is idempotent, so this is retryable
+  too (the client reconnects lazily on the next call).
+- `IngressProtocolError` — the server rejected the *frame* (oversized,
+  malformed, internal); deterministic, NEVER retried: resending a bad
+  request reproduces the error and the retry budget would just burn.
 
 `time.sleep` is the only time-API use here (sleeping, not reading a
 clock — the host-lint timing rule distinguishes the two); the RNG is
@@ -16,17 +28,146 @@ injectable so tests and the chaos sweep stay deterministic.
 from __future__ import annotations
 
 import random
+import socket
+import threading
 import time
-from typing import Optional
+from typing import Optional, Union
 
+from ..api import Error
 from ..models.batch import BatchItem, BatchResult
+from .ingress import (
+    FRAME_ERR,
+    FRAME_REQ,
+    FRAME_RESP,
+    HEADER_LEN,
+    decode_error_payload,
+    decode_header,
+    decode_response_payload,
+    encode_frame,
+    encode_request,
+)
 from .server import OverloadError, VerifyServer
 
-__all__ = ["verify_with_retry"]
+__all__ = ["IngressClient", "IngressProtocolError", "verify_with_retry"]
+
+
+class IngressProtocolError(RuntimeError):
+    """The server rejected the frame itself (typed ERR, code >= 0x100,
+    or an unexpected wire response). Deterministic — never retried."""
+
+    def __init__(self, code: int, reason: str):
+        super().__init__(f"ingress protocol error 0x{code:x}: {reason}")
+        self.code = code
+        self.reason = reason
+
+
+class IngressClient:
+    """Blocking socket client for one `IngressServer`.
+
+    Connects lazily, reconnects on the call after a connection error,
+    and correlates responses by request id (the server may interleave
+    them out of request order). Thread-safe: calls serialize on an
+    internal lock, so shared use degrades to in-order exchanges."""
+
+    def __init__(
+        self,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        timeout_s: float = 30.0,
+    ):
+        if port <= 0:
+            raise ValueError("port must be a bound ingress port")
+        self.host = host
+        self.port = port
+        self.timeout_s = timeout_s
+        self._sock: Optional[socket.socket] = None
+        self._rid = 0
+        self._lock = threading.Lock()
+
+    def __enter__(self) -> "IngressClient":
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        self.close()
+
+    def close(self) -> None:
+        with self._lock:
+            self._drop_locked()
+
+    def _drop_locked(self) -> None:
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+            self._sock = None
+
+    def _sock_locked(self) -> socket.socket:
+        if self._sock is None:
+            self._sock = socket.create_connection(
+                (self.host, self.port), timeout=self.timeout_s
+            )
+        return self._sock
+
+    def _recv_exactly(self, sock: socket.socket, n: int) -> bytes:
+        buf = bytearray()
+        while len(buf) < n:
+            chunk = sock.recv(n - len(buf))
+            if not chunk:
+                raise ConnectionError("server closed the connection")
+            buf.extend(chunk)
+        return bytes(buf)
+
+    def verify(self, item: BatchItem, tenant: str = "default") -> BatchResult:
+        """One request/response exchange; see the module docstring for
+        which failures are retryable."""
+        with self._lock:
+            self._rid += 1
+            rid = self._rid
+            frame = encode_frame(
+                FRAME_REQ, encode_request(rid, tenant, item)
+            )
+            try:
+                sock = self._sock_locked()
+                sock.sendall(frame)
+                return self._await_response_locked(sock, rid)
+            except (ConnectionError, socket.timeout, OSError) as e:
+                # The session is in an unknown framing state: drop it so
+                # the next call starts clean on a fresh connection.
+                self._drop_locked()
+                if isinstance(e, ConnectionError):
+                    raise
+                raise ConnectionError(str(e)) from e
+
+    def _await_response_locked(
+        self, sock: socket.socket, rid: int
+    ) -> BatchResult:
+        while True:
+            hdr = self._recv_exactly(sock, HEADER_LEN)
+            ftype, ln = decode_header(hdr)
+            payload = self._recv_exactly(sock, ln)
+            if ftype == FRAME_RESP:
+                got, res = decode_response_payload(payload)
+                if got == rid:
+                    return res
+                continue  # stale response from an abandoned exchange
+            if ftype == FRAME_ERR:
+                got, code, reason = decode_error_payload(payload)
+                if got not in (rid, 0):
+                    continue
+                if code == int(Error.ERR_OVERLOADED):
+                    raise OverloadError(reason)
+                # Protocol-level ERR frames close the session server-side.
+                self._drop_locked()
+                raise IngressProtocolError(code, reason)
+            self._drop_locked()
+            raise IngressProtocolError(
+                ftype, "unexpected frame type from server"
+            )
 
 
 def verify_with_retry(
-    server: VerifyServer,
+    server: Union[VerifyServer, IngressClient],
     item: BatchItem,
     tenant: str = "default",
     retries: int = 4,
@@ -35,25 +176,37 @@ def verify_with_retry(
     timeout_s: Optional[float] = 60.0,
     rng: Optional[random.Random] = None,
 ) -> BatchResult:
-    """Submit with up to `retries` re-attempts after sheds.
+    """Submit with up to `retries` re-attempts after retryable failures.
 
-    Returns the settled `BatchResult`; re-raises the last
-    `OverloadError` once the retry budget is spent. Batch-driver
-    failures and settle timeouts propagate immediately — only explicit
-    sheds are retried.
+    `server` is either an in-process `VerifyServer` (retries sheds
+    only) or an `IngressClient` (retries explicit `ERR_OVERLOADED`
+    frames and disconnects — never `IngressProtocolError`). Returns the
+    settled `BatchResult`; re-raises the last retryable error once the
+    budget is spent. Batch-driver failures, protocol errors, and settle
+    timeouts propagate immediately.
     """
     if rng is None:
         rng = random.Random()
+    in_proc = isinstance(server, VerifyServer) or hasattr(server, "submit")
     delay = backoff_s
     attempt = 0
     while True:
         try:
-            pending = server.submit(item, tenant)
+            if in_proc:
+                pending = server.submit(item, tenant)
+            else:
+                return server.verify(item, tenant)
         except OverloadError:
             if attempt >= retries:
                 raise
-            attempt += 1
-            time.sleep(delay * (0.5 + rng.random()))  # jitter [0.5x, 1.5x)
-            delay = min(delay * 2, max_backoff_s)
-            continue
-        return pending.result(timeout_s)
+        except ConnectionError:
+            # Wire transport only: a dropped session is retryable (the
+            # client reconnects), a protocol reject never is.
+            if in_proc or attempt >= retries:
+                raise
+        else:
+            if in_proc:
+                return pending.result(timeout_s)
+        attempt += 1
+        time.sleep(delay * (0.5 + rng.random()))  # jitter [0.5x, 1.5x)
+        delay = min(delay * 2, max_backoff_s)

@@ -120,7 +120,7 @@ class PendingVerify:
     """Future for one admitted request; resolved by the worker thread."""
 
     __slots__ = ("item", "tenant", "enqueued", "trace", "submit_span",
-                 "_event", "_result", "_error")
+                 "_event", "_result", "_error", "_cb_lock", "_callbacks")
 
     def __init__(self, item: BatchItem, tenant: str, enqueued: float):
         self.item = item
@@ -135,6 +135,8 @@ class PendingVerify:
         self._event = threading.Event()
         self._result: Optional[BatchResult] = None
         self._error: Optional[BaseException] = None
+        self._cb_lock = threading.Lock()
+        self._callbacks: list = []
 
     def done(self) -> bool:
         return self._event.is_set()
@@ -152,15 +154,46 @@ class PendingVerify:
             raise self._error
         return self._result
 
+    def add_done_callback(self, fn) -> None:
+        """Run `fn(self)` once settled — immediately when already
+        settled, else on the settling thread. The network ingress uses
+        this to hop responses back onto its event loop instead of
+        parking a thread per request. Callback exceptions are contained:
+        a broken observer must not fail the worker's settle sweep."""
+        with self._cb_lock:
+            if not self._event.is_set():
+                self._callbacks.append(fn)
+                return
+        self._run_cb(fn)
+
+    def _run_cb(self, fn) -> None:
+        try:
+            fn(self)
+        except Exception:
+            pass
+
     def _resolve(self, result: BatchResult) -> None:
-        if not self._event.is_set():  # first settlement wins
-            self._result = result
-            self._event.set()
+        self._settle(result, None)
 
     def _fail(self, exc: BaseException) -> None:
-        if not self._event.is_set():
+        self._settle(None, exc)
+
+    def _settle(
+        self, result: Optional[BatchResult], exc: Optional[BaseException]
+    ) -> None:
+        # First settlement wins; the check and the flip share the
+        # callback lock so a racing add_done_callback either registers
+        # before the flip (and is drained here) or observes it set (and
+        # self-runs) — never neither.
+        with self._cb_lock:
+            if self._event.is_set():
+                return
+            self._result = result
             self._error = exc
             self._event.set()
+            cbs, self._callbacks = self._callbacks, []
+        for fn in cbs:
+            self._run_cb(fn)
 
 
 class VerifyServer:
@@ -241,12 +274,16 @@ class VerifyServer:
 
     def close(self, drain: bool = True) -> None:
         """Stop admitting; settle (drain=True) or explicitly cancel
-        (drain=False) everything queued; join the worker. Idempotent."""
+        (drain=False) everything queued; join the worker. Idempotent,
+        including against a concurrently-crashing worker."""
         with self._lock:
-            if self._closed:
-                return
             self._closing = True
+            already = self._closed
             thread = self._thread
+        if already:
+            # Second close still backstops: the first may have raced a
+            # worker crash, and cancel_all below is itself idempotent.
+            thread = None
         if not drain:
             for req in self._queue.cancel_all():
                 self._shed_count(SHED_CLOSED)
@@ -256,6 +293,13 @@ class VerifyServer:
             thread.join(self._join_timeout_s)
             if thread.is_alive():  # never hang shutdown silently
                 raise RuntimeError("serving worker failed to drain in time")
+        # Backstop drain AFTER the join: if the worker died (batch-driver
+        # crash) while a racing submit() was still putting, that request
+        # landed in the queue after the worker's own finally-drain swept
+        # it — without this sweep it would hang its caller forever.
+        for req in self._queue.cancel_all():
+            self._shed_count(SHED_CLOSED)
+            req._fail(OverloadError(SHED_CLOSED))
         with self._lock:
             self._closed = True
 

@@ -918,6 +918,758 @@ def run_serve_sweep(seed: int) -> dict:
             "overhead": overhead}
 
 
+def _ingress_stack(server_kw, idle_s=10.0, max_frame=1 << 20,
+                   sig_cache=None):
+    """Live VerifyServer + IngressServer pair for one trial."""
+    from bitcoinconsensus_tpu.serving import IngressServer, VerifyServer
+
+    if sig_cache is None:
+        sig_cache, script_cache = _fresh_caches()
+    else:
+        _, script_cache = _fresh_caches()
+    vs = VerifyServer(
+        sig_cache=sig_cache, script_cache=script_cache, **server_kw
+    ).start()
+    ing = IngressServer(vs, idle_s=idle_s, max_frame=max_frame).start()
+    return vs, ing
+
+
+def _ingress_trial(name, items, oracle, specs, seed, server_kw,
+                   n_threads=4, retries=0, expect_sheds=False,
+                   shared_tenant=None):
+    """N concurrent socket clients against a live ingress + server pair.
+
+    The wire analogue of `_serve_trial`: every request ends in exactly
+    one explicit outcome — a settled verdict over the socket (compared
+    bit-for-bit against the host oracle), an `ERR_OVERLOADED` frame
+    (surfaced as `OverloadError`), or — under injected read/write
+    faults — a typed disconnect the retry client recovers from.
+    """
+    import random
+    import threading
+
+    from bitcoinconsensus_tpu.resilience import FaultPlan, inject
+    from bitcoinconsensus_tpu.serving import (
+        IngressClient,
+        IngressProtocolError,
+        OverloadError,
+    )
+    from bitcoinconsensus_tpu.serving import ingress as ingress_mod
+    from bitcoinconsensus_tpu.serving.client import verify_with_retry
+
+    outcomes = [None] * len(items)
+    sessions0 = ingress_mod._I_SESSIONS.value()
+
+    def client(tid, port):
+        rng = random.Random(seed * 1013 + tid)
+        tenant = shared_tenant if shared_tenant else f"t{tid}"
+        cli = IngressClient(port=port, timeout_s=120)
+        try:
+            for i in range(tid, len(items), n_threads):
+                try:
+                    if retries:
+                        res = verify_with_retry(
+                            cli, items[i], tenant=tenant,
+                            retries=retries, backoff_s=0.02,
+                            max_backoff_s=0.3, rng=rng,
+                        )
+                    else:
+                        res = cli.verify(items[i], tenant=tenant)
+                    outcomes[i] = ("ok", res.ok)
+                except OverloadError as e:
+                    outcomes[i] = ("shed", e.reason)
+                except (ConnectionError, IngressProtocolError) as e:
+                    outcomes[i] = ("error", repr(e))
+                except Exception as e:  # anything else fails the trial
+                    outcomes[i] = ("error", repr(e))
+        finally:
+            cli.close()
+
+    with inject(FaultPlan(specs), seed=seed) as inj:
+        vs, ing = _ingress_stack(server_kw)
+        try:
+            threads = [
+                threading.Thread(target=client, args=(t, ing.port))
+                for t in range(n_threads)
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(180)
+            hung = any(t.is_alive() for t in threads)
+        finally:
+            ing.close(drain=True)
+            vs.close(drain=True)
+
+    admitted = [i for i, o in enumerate(outcomes) if o and o[0] == "ok"]
+    sheds = [i for i, o in enumerate(outcomes) if o and o[0] == "shed"]
+    errors = [
+        i for i, o in enumerate(outcomes) if o is None or o[0] == "error"
+    ]
+    row = {
+        "trial": name,
+        "fired": {f"{s}:{k}": c for (s, k), c in sorted(inj.fired.items())},
+        "admitted": len(admitted),
+        "shed": len(sheds),
+        "errors": len(errors),
+        "bit_identical": bool(admitted) and all(
+            outcomes[i][1] == oracle[i] for i in admitted
+        ),
+        "no_hangs": not hung,
+        "all_settled": vs.pending == 0,
+        "sessions_counted": ingress_mod._I_SESSIONS.value()
+        >= sessions0 + n_threads,
+    }
+    if specs:
+        row["fault_fired"] = inj.total_fired() >= 1
+        # Injected wire faults surface as disconnects; without retries
+        # those land in `errors` by design, so only the fault-free and
+        # retry trials demand a fully explicit outcome set.
+        row["retry_recovered"] = len(admitted) == len(items)
+    else:
+        row["all_sheds_explicit"] = not errors
+    if expect_sheds:
+        row["sheds_happened"] = len(sheds) >= 1
+        row["some_admitted"] = len(admitted) >= 1
+    if retries and not specs:
+        row["retry_recovered"] = len(admitted) == len(items)
+    return row
+
+
+def _ingress_pipelined_shed_trial(items, oracle, seed, server_kw,
+                                  n_threads=4):
+    """Overload shed over the wire, pipelined.
+
+    Each tenant fires its requests back-to-back on one session without
+    waiting (the framing protocol allows it — responses carry rids), so
+    with `tenant_depth=2` the third queued submit per tenant MUST come
+    back as an explicit `ERR_OVERLOADED` frame on a session that stays
+    open, while the admitted verdicts stay bit-identical."""
+    import socket as socketlib
+    import threading
+
+    from bitcoinconsensus_tpu.api import Error
+    from bitcoinconsensus_tpu.serving.ingress import (
+        FRAME_ERR,
+        FRAME_REQ,
+        FRAME_RESP,
+        HEADER_LEN,
+        decode_error_payload,
+        decode_header,
+        decode_response_payload,
+        encode_frame,
+        encode_request,
+    )
+
+    outcomes = [None] * len(items)
+    overload_code = int(Error.ERR_OVERLOADED)
+
+    def _recv_frame(sock):
+        buf = b""
+        while len(buf) < HEADER_LEN:
+            chunk = sock.recv(HEADER_LEN - len(buf))
+            if not chunk:
+                return None
+            buf += chunk
+        ftype, ln = decode_header(buf)
+        payload = b""
+        while len(payload) < ln:
+            chunk = sock.recv(ln - len(payload))
+            if not chunk:
+                return None
+            payload += chunk
+        return ftype, payload
+
+    def client(tid, port):
+        mine = list(range(tid, len(items), n_threads))
+        sock = socketlib.create_connection(("127.0.0.1", port), timeout=120)
+        sock.settimeout(120)
+        try:
+            for i in mine:  # the whole burst before the first read
+                sock.sendall(encode_frame(
+                    FRAME_REQ, encode_request(i + 1, f"t{tid}", items[i])
+                ))
+            for _ in mine:
+                frame = _recv_frame(sock)
+                if frame is None:
+                    break  # remaining outcomes stay None -> trial fails
+                ftype, payload = frame
+                if ftype == FRAME_RESP:
+                    rid, res = decode_response_payload(payload)
+                    outcomes[rid - 1] = ("ok", res.ok)
+                elif ftype == FRAME_ERR:
+                    rid, code, reason = decode_error_payload(payload)
+                    kind = "shed" if code == overload_code else "error"
+                    if rid:
+                        outcomes[rid - 1] = (kind, code)
+        finally:
+            sock.close()
+
+    vs, ing = _ingress_stack(server_kw)
+    try:
+        threads = [
+            threading.Thread(target=client, args=(t, ing.port))
+            for t in range(n_threads)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(180)
+        hung = any(t.is_alive() for t in threads)
+    finally:
+        ing.close(drain=True)
+        vs.close(drain=True)
+
+    admitted = [i for i, o in enumerate(outcomes) if o and o[0] == "ok"]
+    sheds = [i for i, o in enumerate(outcomes) if o and o[0] == "shed"]
+    errors = [
+        i for i, o in enumerate(outcomes) if o is None or o[0] == "error"
+    ]
+    return {
+        "trial": "ingress-overload-shed",
+        "fired": {},
+        "admitted": len(admitted),
+        "shed": len(sheds),
+        "errors": len(errors),
+        "bit_identical": bool(admitted) and all(
+            outcomes[i][1] == oracle[i] for i in admitted
+        ),
+        "all_sheds_explicit": not errors,
+        "no_hangs": not hung,
+        "all_settled": vs.pending == 0,
+        "sheds_happened": len(sheds) >= 1,
+        "some_admitted": len(admitted) >= 1,
+    }
+
+
+def _ingress_misbehavior_trial(items, oracle, seed):
+    """Hostile connections against a serving session: disconnect
+    mid-request, slow-loris, truncated and garbage frames — each torn
+    down per-connection (typed ERR frame or deadline reap) while a
+    well-behaved client on the SAME server stays bit-identical."""
+    import socket as socketlib
+    import threading
+
+    from bitcoinconsensus_tpu.serving import IngressClient
+    from bitcoinconsensus_tpu.serving import ingress as ingress_mod
+    from bitcoinconsensus_tpu.serving.ingress import (
+        FRAME_ERR,
+        FRAME_REQ,
+        HEADER_LEN,
+        decode_error_payload,
+        decode_header,
+        encode_frame,
+    )
+
+    reaps0 = ingress_mod._I_REAPS.value()
+    perrs0 = ingress_mod._I_PROTO_ERRS.value()
+    results = [None] * len(items)
+    idle_s = 1.0
+    vs, ing = _ingress_stack(
+        dict(max_batch=8, flush_s=0.005, tenant_depth=64), idle_s=idle_s
+    )
+
+    def well_behaved():
+        cli = IngressClient(port=ing.port, timeout_s=120)
+        try:
+            for i, item in enumerate(items):
+                results[i] = cli.verify(item).ok
+        finally:
+            cli.close()
+
+    def _recv_frame(sock):
+        buf = b""
+        while len(buf) < HEADER_LEN:
+            chunk = sock.recv(HEADER_LEN - len(buf))
+            if not chunk:
+                return None
+            buf += chunk
+        ftype, ln = decode_header(buf)
+        payload = b""
+        while len(payload) < ln:
+            chunk = sock.recv(ln - len(payload))
+            if not chunk:
+                return None
+            payload += chunk
+        return ftype, payload
+
+    garbage_typed = []
+
+    def misbehave():
+        # Disconnect mid-request: half a frame, then vanish.
+        s = socketlib.create_connection(("127.0.0.1", ing.port), timeout=30)
+        s.sendall(bytes([FRAME_REQ]) + (64).to_bytes(4, "big") + b"half")
+        s.close()
+        # Garbage frame type: must earn a typed ERR frame, then close.
+        s = socketlib.create_connection(("127.0.0.1", ing.port), timeout=30)
+        s.sendall(encode_frame(0x7E, b"junk"))
+        frame = _recv_frame(s)
+        if frame is not None and frame[0] == FRAME_ERR:
+            garbage_typed.append(decode_error_payload(frame[1])[1])
+        s.close()
+        # Slow-loris: start a frame, stall past the read deadline.
+        s = socketlib.create_connection(("127.0.0.1", ing.port), timeout=30)
+        s.sendall(bytes([FRAME_REQ]) + (128).to_bytes(4, "big") + b"\x00")
+        s.settimeout(30)
+        try:
+            s.recv(1)  # blocks until the server reaps us
+        except OSError:
+            pass
+        s.close()
+
+    try:
+        wt = threading.Thread(target=well_behaved)
+        mt = threading.Thread(target=misbehave)
+        wt.start()
+        mt.start()
+        wt.join(180)
+        mt.join(180)
+        hung = wt.is_alive() or mt.is_alive()
+        # The server outlived its attackers: one more verified request.
+        cli = IngressClient(port=ing.port, timeout_s=120)
+        try:
+            survived = cli.verify(items[1]).ok == oracle[1]
+        finally:
+            cli.close()
+    finally:
+        ing.close(drain=True)
+        vs.close(drain=True)
+
+    return {
+        "trial": "ingress-misbehavior",
+        "fired": {},
+        "bit_identical": results == oracle,
+        "no_hangs": not hung,
+        "loris_reaped": ingress_mod._I_REAPS.value() >= reaps0 + 1,
+        "garbage_typed_error": bool(garbage_typed),
+        "truncated_counted": ingress_mod._I_PROTO_ERRS.value()
+        >= perrs0 + 2,  # the half-frame disconnect AND the garbage type
+        "server_survived": survived,
+    }
+
+
+def _ingress_drain_trial(items, oracle):
+    """Graceful drain over the wire: responses for everything submitted
+    flush before the session closes, and the listener is gone after."""
+    import socket as socketlib
+    import time as timelib
+
+    from bitcoinconsensus_tpu.serving.ingress import (
+        FRAME_REQ,
+        FRAME_RESP,
+        HEADER_LEN,
+        decode_header,
+        decode_response_payload,
+        encode_frame,
+        encode_request,
+    )
+
+    n = 5
+    vs, ing = _ingress_stack(
+        dict(max_batch=8, flush_s=0.005, tenant_depth=64)
+    )
+    port = ing.port
+    try:
+        sock = socketlib.create_connection(("127.0.0.1", port), timeout=30)
+        sock.settimeout(30)
+        for rid in range(1, n + 1):
+            sock.sendall(encode_frame(
+                FRAME_REQ, encode_request(rid, "drain", items[rid])
+            ))
+        # Give the loop a beat to submit everything, then drain.
+        deadline = timelib.monotonic() + 2
+        while vs.pending == 0 and timelib.monotonic() < deadline:
+            timelib.sleep(0.005)
+        ing.close(drain=True)
+
+        got = {}
+        eof = False
+        for _ in range(n + 1):
+            buf = b""
+            while len(buf) < HEADER_LEN:
+                chunk = sock.recv(HEADER_LEN - len(buf))
+                if not chunk:
+                    eof = True
+                    break
+                buf += chunk
+            if eof:
+                break
+            ftype, ln = decode_header(buf)
+            payload = b""
+            while len(payload) < ln:
+                payload += sock.recv(ln - len(payload))
+            if ftype == FRAME_RESP:
+                rid, res = decode_response_payload(payload)
+                got[rid] = res.ok
+        sock.close()
+        try:
+            socketlib.create_connection(("127.0.0.1", port), timeout=2)
+            listener_dead = False
+        except OSError:
+            listener_dead = True
+    finally:
+        vs.close(drain=True)
+
+    return {
+        "trial": "ingress-drain",
+        "fired": {},
+        "bit_identical": [got.get(r) for r in range(1, n + 1)]
+        == [oracle[r] for r in range(1, n + 1)],
+        "drained_responses_flushed": len(got) == n,
+        "eof_after_drain": eof,
+        "listener_closed": listener_dead,
+        "all_settled": vs.pending == 0,
+    }
+
+
+def _sigstore_restart_trial(seed):
+    """Kill-and-restart with a poisoned persisted entry.
+
+    Pass 1 populates a persistent store through the real driver; the
+    bad item's true cache keys are then planted (what an undetected
+    corruption or hostile writer amounts to) and the process 'crashes'
+    (drop without close). The restarted store must replay warm, serve a
+    repeat workload at >= 90% hit rate with ZERO device re-dispatch for
+    clean entries, and audit re-verify must catch the poisoned hit,
+    evict it, and keep it evicted across a THIRD restart.
+
+    The workload is single-signature wallets only, deliberately: a
+    CHECKMULTISIG pair scan probes (sig, pubkey) pairs that verify
+    false and are never cached (failures are fail-closed), so a
+    multisig workload's steady-state hit rate sits below 100% even
+    WITHOUT a restart — it would measure script shape, not persistence.
+    Here every clean check is cacheable, so any miss on the repeat pass
+    is a real persistence loss."""
+    import tempfile
+
+    from bitcoinconsensus_tpu.core.interpreter import verify_script
+    from bitcoinconsensus_tpu.core.sighash import PrecomputedTxData
+    from bitcoinconsensus_tpu.core.tx import Tx, TxOut
+    from bitcoinconsensus_tpu.models.batch import (
+        DeferringSignatureChecker,
+        verify_batch,
+    )
+    from bitcoinconsensus_tpu.models.sigcache import ScriptExecutionCache
+    from bitcoinconsensus_tpu.models.sigstore import PersistentSigCache
+    from bitcoinconsensus_tpu.resilience.guards import (
+        CACHE_POISON_CAUGHT,
+        set_cache_audit,
+    )
+
+    from bitcoinconsensus_tpu.utils import blockgen
+
+    _view, funded = blockgen.make_funded_view(
+        10, seed="sigstore", kinds=("p2pkh", "p2wpkh")
+    )
+    items = _batch_items(funded, bad_first=True)
+    o_sig, o_script = _fresh_caches()
+    oracle = [
+        r.ok for r in verify_batch(
+            items, sig_cache=o_sig, script_cache=o_script)
+    ]
+    assert not oracle[0] and all(oracle[1:]), oracle
+
+    store_dir = tempfile.mkdtemp(prefix="chaos-sigstore-")
+    store = PersistentSigCache(store_dir, hot_entries=64, shards=4,
+                              warmup_min_probes=4)
+    res1 = verify_batch(
+        items, sig_cache=store,
+        script_cache=ScriptExecutionCache(cache_label="chaos-ss1"),
+    )
+    pass1_ok = [r.ok for r in res1] == oracle
+
+    # Harvest the bad item's REAL cache keys (the driver never caches
+    # failures, so a poisoned store is the only way they get in).
+    bad = items[0]
+    tx = Tx.deserialize(bad.spending_tx)
+    spent = [TxOut(a, s) for a, s in bad.spent_outputs]
+    checker = DeferringSignatureChecker(
+        tx, bad.input_index, spent[bad.input_index].value,
+        PrecomputedTxData(tx, spent), known={},
+    )
+    verify_script(
+        tx.vin[bad.input_index].script_sig,
+        spent[bad.input_index].script_pubkey,
+        tx.vin[bad.input_index].witness, bad.flags, checker,
+    )
+    poison_keys = store.keys_for_checks(checker.recorded)
+    for k in poison_keys:
+        store.add_key(k)
+    store.flush()
+    del store  # crash, not close
+
+    # Restart: replay warms the cache from disk.
+    store2 = PersistentSigCache(store_dir, hot_entries=64, shards=4,
+                                warmup_min_probes=4)
+    replay_warm = len(store2) > 0 and store2.replay_skipped == 0
+    poison_persisted = all(store2.contains_key(k) for k in poison_keys)
+    probes0 = store2._probes_since_open
+    hits0 = store2._hits_since_open
+    # Warm repeat of the CLEAN workload first (audit off): every probe
+    # must be answered by the replayed store — zero driver-level misses
+    # == zero device lanes dispatched for persisted entries (the uniq
+    # dispatch ships misses only). The known-bad item is excluded here
+    # by construction: failures are never cached, so its probes always
+    # miss and re-verify — that is fail-closed, not cold.
+    res2a = verify_batch(
+        items[1:], sig_cache=store2,
+        script_cache=ScriptExecutionCache(cache_label="chaos-ss2a"),
+    )
+    probes = store2._probes_since_open - probes0
+    hits = store2._hits_since_open - hits0
+    # Then the FULL workload with audit re-verify armed: the poisoned
+    # persisted hit must be convicted on the host oracle and evicted.
+    caught0 = CACHE_POISON_CAUGHT.value(cache="sig")
+    set_cache_audit(True)
+    try:
+        res2 = verify_batch(
+            items, sig_cache=store2,
+            script_cache=ScriptExecutionCache(cache_label="chaos-ss2"),
+        )
+    finally:
+        set_cache_audit(False)
+    caught = CACHE_POISON_CAUGHT.value(cache="sig") - caught0
+    store2.close()
+
+    store3 = PersistentSigCache(store_dir, hot_entries=64, shards=4)
+    poison_evicted_durably = not any(
+        store3.contains_key(k) for k in poison_keys
+    )
+    store3.close()
+
+    return {
+        "trial": "sigstore-kill-restart-poison",
+        "fired": {},
+        "pass1_bit_identical": pass1_ok,
+        "bit_identical": [r.ok for r in res2a] == oracle[1:]
+        and [r.ok for r in res2] == oracle,
+        "replay_warm": replay_warm,
+        "poison_persisted_to_disk": poison_persisted,
+        "poison_caught_by_audit": caught >= 1,
+        "warm_hit_rate_ok": probes > 0 and 10 * hits >= 9 * probes
+        and store2.warmup_s is not None,
+        "no_device_reverify_of_clean_entries": probes > 0
+        and hits == probes,
+        "poison_evicted_durably": poison_evicted_durably,
+        "warmup_s": store2.warmup_s,
+        "probes": probes,
+    }
+
+
+def _sigstore_corrupt_trial():
+    """Truncated-tail and flipped-checksum records: replay must skip
+    them fail-closed, heal the log to a record boundary, and keep the
+    store serving."""
+    import os as oslib
+    import tempfile
+
+    from bitcoinconsensus_tpu.models.sigstore import (
+        PersistentSigCache,
+        _REC_LEN,
+    )
+
+    store_dir = tempfile.mkdtemp(prefix="chaos-sigstore-corrupt-")
+    store = PersistentSigCache(store_dir, hot_entries=16, shards=2)
+    keys = [bytes([i]) + i.to_bytes(31, "little") for i in range(12)]
+    for k in keys:
+        store.add_key(k)
+    store.close()
+
+    logs = sorted(
+        oslib.path.join(store_dir, p)
+        for p in oslib.listdir(store_dir)
+        if p.endswith(".log") and oslib.path.getsize(
+            oslib.path.join(store_dir, p)) > 0
+    )
+    # Flip a checksum byte in one log, tear the tail of another.
+    with open(logs[0], "r+b") as fh:
+        fh.seek(-1, 2)
+        last = fh.read(1)
+        fh.seek(-1, 2)
+        fh.write(bytes([last[0] ^ 0xFF]))
+    with open(logs[-1], "ab") as fh:
+        fh.write(b"\x41\x13\x37")  # torn mid-append
+
+    store2 = PersistentSigCache(store_dir, hot_entries=16, shards=2)
+    healed = all(
+        oslib.path.getsize(p) % _REC_LEN == 0 for p in logs
+    )
+    still_serving = store2.contains_key(keys[1]) or len(store2) > 0
+    survivors = sum(1 for k in keys if store2.contains_key(k))
+    store2.close()
+    return {
+        "trial": "sigstore-corrupt-replay",
+        "fired": {},
+        "bit_identical": True,  # no verdicts involved in this leg
+        "corrupt_skipped": store2.replay_skipped >= 2,
+        "logs_healed": healed,
+        "fail_closed_misses_only": survivors < 12 and store2.replay_applied
+        == survivors,
+        "still_serving": still_serving,
+    }
+
+
+def _sigstore_fault_trial(seed):
+    """Armed `sigstore.load` / `sigstore.append` faults: a replay fault
+    leaves one shard cold (store opens, contained), an append fault
+    costs persistence of one record (never the in-RAM verdict path)."""
+    import tempfile
+
+    from bitcoinconsensus_tpu.models.sigstore import PersistentSigCache
+    from bitcoinconsensus_tpu.resilience import FaultPlan, FaultSpec, inject
+
+    store_dir = tempfile.mkdtemp(prefix="chaos-sigstore-fault-")
+    store = PersistentSigCache(store_dir, hot_entries=16, shards=4)
+    keys = [bytes([i]) + (1000 + i).to_bytes(31, "little") for i in range(16)]
+    for k in keys:
+        store.add_key(k)
+    store.close()
+
+    plan = FaultPlan([FaultSpec("sigstore.load", "raise", count=1)])
+    with inject(plan, seed=seed) as inj_load:
+        store2 = PersistentSigCache(store_dir, hot_entries=16, shards=4)
+    load_contained = 0 < len(store2) < 16 and store2.replay_skipped >= 1
+
+    plan = FaultPlan([FaultSpec("sigstore.append", "raise", count=1)])
+    k_lost = b"\xfe" * 32
+    with inject(plan, seed=seed) as inj_app:
+        store2.add_key(k_lost)
+    ram_ok = store2.contains_key(k_lost)  # verdict path unaffected
+    store2.close()
+    store3 = PersistentSigCache(store_dir, hot_entries=16, shards=4)
+    lost_on_disk = not store3.contains_key(k_lost)
+    store3.close()
+
+    return {
+        "trial": "sigstore-fault-sites",
+        "fired": {
+            **{f"{s}:{k}": c for (s, k), c in sorted(inj_load.fired.items())},
+            **{f"{s}:{k}": c for (s, k), c in sorted(inj_app.fired.items())},
+        },
+        "fault_fired": inj_load.total_fired() + inj_app.total_fired() >= 2,
+        "bit_identical": True,  # no verdicts involved in this leg
+        "load_fault_contained": load_contained,
+        "append_fault_contained": ram_ok and lost_on_disk,
+    }
+
+
+def _ingress_overhead(items):
+    """Disarmed fault-hook cost along the ingress + persistent-store
+    path, as a fraction of pumping the workload over a live socket —
+    hook-timing accounting, same style as `_overhead_budget`."""
+    import tempfile
+
+    import bitcoinconsensus_tpu.resilience.faults as F
+    from bitcoinconsensus_tpu.models.sigcache import ScriptExecutionCache
+    from bitcoinconsensus_tpu.models.sigstore import PersistentSigCache
+    from bitcoinconsensus_tpu.serving import (
+        IngressClient,
+        IngressServer,
+        VerifyServer,
+    )
+
+    def run():
+        store = PersistentSigCache(
+            tempfile.mkdtemp(prefix="chaos-ingress-ovh-"),
+            hot_entries=256, shards=4,
+        )
+        vs = VerifyServer(
+            sig_cache=store,
+            script_cache=ScriptExecutionCache(cache_label="chaos-ovh"),
+            max_batch=8, flush_s=0.005, tenant_depth=64,
+        ).start()
+        ing = IngressServer(vs, idle_s=10.0).start()
+        cli = IngressClient(port=ing.port, timeout_s=120)
+        try:
+            for item in items:
+                cli.verify(item)
+        finally:
+            cli.close()
+            ing.close(drain=True)
+            vs.close(drain=True)
+            store.close()
+
+    run()  # warm jit/compile caches; timing below excludes compiles
+    wall = min(_timed(run) for _ in range(3))
+
+    targets = [
+        (F, "maybe_raise"), (F, "poison_hit"), (F, "active"),
+    ]
+    spent = {f"faults.{n}": 0.0 for _, n in targets}
+    calls = {f"faults.{n}": 0 for _, n in targets}
+    saved = [(o, n, getattr(o, n)) for o, n in targets]
+
+    def _timing(key, fn):
+        def wrapper(*a, **kw):
+            t0 = time.perf_counter()
+            try:
+                return fn(*a, **kw)
+            finally:
+                spent[key] += time.perf_counter() - t0
+                calls[key] += 1
+        return wrapper
+
+    try:
+        for o, n, fn in saved:
+            setattr(o, n, _timing(f"faults.{n}", fn))
+        run()
+    finally:
+        for o, n, fn in saved:
+            setattr(o, n, fn)
+
+    total = sum(spent.values())
+    return {
+        "wall_s": wall,
+        "hooks_s": total,
+        "ratio": total / wall,
+        "hook_calls": {k: v for k, v in sorted(calls.items()) if v},
+        "budget_ok": total < 0.01 * wall,
+    }
+
+
+def run_ingress_sweep(seed: int) -> dict:
+    """Network ingress + persistent sigstore sweep (the PR 14 gate)."""
+    from bitcoinconsensus_tpu.resilience import FaultSpec
+
+    items, oracle = _serve_items_and_oracle()
+    normal = dict(max_batch=8, flush_s=0.005, tenant_depth=64)
+    # Synthetic overload, as in the serve sweep: nothing size-flushes,
+    # slow time flush, tenant depth 2 — back-to-back submits must shed.
+    overload = dict(max_batch=64, flush_s=0.05, tenant_depth=2)
+
+    trials = [
+        _ingress_trial("ingress-clean", items, oracle, [], seed, normal),
+        _ingress_pipelined_shed_trial(items, oracle, seed, overload),
+        # All four client threads share ONE tenant against depth 2, so
+        # the concurrent burst sheds at the wire and the bounded-retry
+        # client must win every verdict back.
+        _ingress_trial(
+            "ingress-overload-retry", items, oracle, [], seed, overload,
+            retries=12, shared_tenant="hot",
+        ),
+        # Injected wire faults: sessions tear down explicitly, the
+        # bounded-retry client reconnects and recovers every verdict.
+        _ingress_trial(
+            "ingress-read-fault", items, oracle,
+            [FaultSpec("ingress.read", "raise", count=2)], seed, normal,
+            retries=8,
+        ),
+        _ingress_trial(
+            "ingress-write-fault", items, oracle,
+            [FaultSpec("ingress.write", "raise", count=2)], seed, normal,
+            retries=8,
+        ),
+        _ingress_misbehavior_trial(items, oracle, seed),
+        _ingress_drain_trial(items, oracle),
+        _sigstore_restart_trial(seed),
+        _sigstore_corrupt_trial(),
+        _sigstore_fault_trial(seed),
+    ]
+    overhead = _ingress_overhead(items)
+    return {"seed": seed, "ingress": True, "trials": trials,
+            "overhead": overhead}
+
+
 def _problems(report: dict) -> list:
     probs = []
     for t in report["trials"]:
@@ -936,7 +1688,20 @@ def _problems(report: dict) -> list:
                     "explicit_reject_after_close", "admit_cold_start",
                     "admit_shallow", "shed_on_deep_queue",
                     "quarantined_sheds_earlier",
-                    "shed_recovers_after_probe"):
+                    "shed_recovers_after_probe",
+                    # ingress + sigstore sweep hard criteria
+                    "sessions_counted", "loris_reaped",
+                    "garbage_typed_error", "truncated_counted",
+                    "server_survived", "drained_responses_flushed",
+                    "eof_after_drain", "listener_closed",
+                    "pass1_bit_identical", "replay_warm",
+                    "poison_persisted_to_disk", "poison_caught_by_audit",
+                    "warm_hit_rate_ok",
+                    "no_device_reverify_of_clean_entries",
+                    "poison_evicted_durably", "corrupt_skipped",
+                    "logs_healed", "fail_closed_misses_only",
+                    "still_serving", "load_fault_contained",
+                    "append_fault_contained"):
             if t.get(key) is False:
                 probs.append(f"{t['trial']}: {key} is False")
     ov = report["overhead"]
@@ -965,9 +1730,16 @@ def main(argv=None) -> int:
                     help="run the serving-layer sweep: concurrent client "
                     "threads against injected faults and synthetic "
                     "overload through a live VerifyServer")
+    ap.add_argument("--ingress", action="store_true",
+                    help="run the network-ingress + persistent-sigstore "
+                    "sweep: hostile sockets, wire faults, overload sheds "
+                    "over the wire, and kill-and-restart replay with a "
+                    "poisoned persisted entry")
     args = ap.parse_args(argv)
 
-    if args.serve:
+    if args.ingress:
+        report = run_ingress_sweep(args.seed)
+    elif args.serve:
         report = run_serve_sweep(args.seed)
     elif args.mesh:
         report = run_mesh_sweep(args.seed)

@@ -104,6 +104,22 @@ REQUIRED_METRICS = [
     "consensus_serving_batch_seconds",
     "consensus_serving_slo_seconds",
     "consensus_serving_batches_total",
+    # network ingress (the workload's socket leg: one verified round
+    # trip, one garbage frame, one reaped slow-loris; the write-error
+    # path only lights up under scripts/consensus_chaos.py --ingress)
+    "consensus_ingress_sessions_total",
+    "consensus_ingress_frames_total",
+    "consensus_ingress_bytes_total",
+    "consensus_ingress_deadline_reaps_total",
+    "consensus_ingress_protocol_errors_total",
+    # persistent sigstore (populate, crash-free reopen, warm replay;
+    # the skip/append-error counters are chaos-sweep-only)
+    "consensus_sigstore_hits_total",
+    "consensus_sigstore_misses_total",
+    "consensus_sigstore_tier_entries",
+    "consensus_sigstore_warmup_seconds",
+    "consensus_sigstore_replay_records_total",
+    "consensus_sigstore_appends_total",
     # spans
     "consensus_span_duration_seconds",
 ]
@@ -189,6 +205,59 @@ def run_mini_workload() -> None:
     expect(api.Error.ERR_OVERLOADED, srv2.submit, items[1])
     srv2.close(drain=True)  # graceful drain settles the queued request
     assert queued.result(timeout=60).ok and srv2.pending == 0
+
+    # --- network ingress: one verified socket round trip, a garbage
+    # frame (protocol-error counter), and a reaped slow-loris (deadline
+    # counter) against a short-idle listener ---
+    import socket as socketlib
+
+    from bitcoinconsensus_tpu.serving import IngressClient, IngressServer
+    from bitcoinconsensus_tpu.serving.ingress import encode_frame
+
+    with VerifyServer(max_batch=8, flush_s=0.005, tenant_depth=8) as srv3:
+        ing = IngressServer(srv3, idle_s=0.2).start()
+        try:
+            cli = IngressClient(port=ing.port, timeout_s=60)
+            assert cli.verify(items[0]).ok
+            cli.close()
+            s = socketlib.create_connection(
+                ("127.0.0.1", ing.port), timeout=30
+            )
+            s.sendall(encode_frame(0x7D, b"junk"))  # unknown frame type
+            s.settimeout(30)
+            s.recv(64)  # typed ERR frame comes back, then EOF
+            s.close()
+            s = socketlib.create_connection(
+                ("127.0.0.1", ing.port), timeout=30
+            )
+            s.sendall(b"\x01\x00\x00\x00\x40")  # header only, then stall
+            s.settimeout(30)
+            while s.recv(64):  # blocks until the deadline reap closes us
+                pass
+            s.close()
+        finally:
+            ing.close(drain=True)
+
+    # --- persistent sigstore: populate through the driver, reopen (warm
+    # replay), and replay the same workload so the hit/warm-up side of
+    # the two-tier store samples alongside the cold-pass misses ---
+    import tempfile
+
+    from bitcoinconsensus_tpu.models.sigcache import ScriptExecutionCache
+    from bitcoinconsensus_tpu.models.sigstore import PersistentSigCache
+
+    sdir = tempfile.mkdtemp(prefix="stats-sigstore-")
+    good = items[:4]
+    with PersistentSigCache(sdir, hot_entries=64, shards=2,
+                            warmup_min_probes=2) as store:
+        verify_batch(good, sig_cache=store,
+                     script_cache=ScriptExecutionCache(cache_label="ss1"))
+    with PersistentSigCache(sdir, hot_entries=64, shards=2,
+                            warmup_min_probes=2) as store2:
+        assert len(store2) > 0  # replay warmed the cold tier
+        verify_batch(good, sig_cache=store2,
+                     script_cache=ScriptExecutionCache(cache_label="ss2"))
+        assert store2.warmup_s is not None  # >=90% hits on the repeat
 
     # --- block connect: one valid block, one failing replay ---
     bview, bfunded = blockgen.make_funded_view(4, height=1, seed="stats-blk")

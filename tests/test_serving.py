@@ -473,3 +473,100 @@ def test_settle_span_parents_to_submit_span_across_worker_thread():
               if r["name"].startswith("batch.stream_")]
     leader_traces = {r["trace"] for r in submits}
     assert driver and all(r["trace"] in leader_traces for r in driver)
+
+
+# -- close() vs a concurrently-crashing worker (race-free shutdown) ----
+
+
+def test_close_race_with_crashing_worker_settles_stranded_put():
+    """A submit racing a worker crash can land its request in the queue
+    AFTER the dead worker's backstop drain swept it; close(drain=True)
+    must sweep again after the join, or that caller hangs forever."""
+    from bitcoinconsensus_tpu.serving.server import PendingVerify
+
+    items = _items(2, bad_first=False)
+    srv = VerifyServer(max_batch=2, flush_s=0.001, tenant_depth=8).start()
+
+    # Simulate an unexpected worker death (anything escaping the burst
+    # handler): settle what was popped — _run_burst's contract — then
+    # propagate, killing the worker thread itself.
+    def kill(first):
+        for r in first:
+            r._fail(RuntimeError("worker died"))
+        raise RuntimeError("worker died")
+
+    srv._run_burst = kill
+    p0 = srv.submit(items[0])
+    with pytest.raises(RuntimeError, match="worker died"):
+        p0.result(timeout=30)
+    srv._thread.join(30)  # the worker is now dead
+    assert not srv._thread.is_alive()
+    # Replay the race deterministically: a put that slipped in after the
+    # dead worker's own drain (submit() already sheds by now, but the
+    # queue itself is still open — exactly the raced window).
+    stranded = PendingVerify(items[1], "default", 0.0)
+    srv._queue.put(stranded)
+    srv.close(drain=True)  # must NOT leave `stranded` unsettled
+    with pytest.raises(OverloadError) as ei:
+        stranded.result(timeout=5)
+    assert ei.value.reason == SHED_CLOSED
+    srv.close()  # and double-close stays a no-op
+    assert srv.pending == 0
+
+
+def test_double_close_concurrent_with_worker_crash(monkeypatch):
+    """Two concurrent close() calls racing a crashing worker: both must
+    return (no deadlock, no exception), everything admitted settles."""
+    import threading as _threading
+
+    import bitcoinconsensus_tpu.serving.server as server_mod
+
+    def boom(*a, **k):
+        raise RuntimeError("driver crashed")
+        yield  # pragma: no cover - makes this a generator function
+
+    monkeypatch.setattr(server_mod, "verify_batch_stream", boom)
+    items = _items(2, bad_first=False)
+    srv = VerifyServer(max_batch=2, flush_s=0.001, tenant_depth=8).start()
+    pend = [srv.submit(it) for it in items]
+    errs = []
+
+    def closer():
+        try:
+            srv.close(drain=True)
+        except Exception as e:  # pragma: no cover - surfaced below
+            errs.append(e)
+
+    t1 = _threading.Thread(target=closer)
+    t2 = _threading.Thread(target=closer)
+    t1.start(); t2.start()
+    t1.join(30); t2.join(30)
+    assert not t1.is_alive() and not t2.is_alive()
+    assert not errs
+    for p in pend:
+        with pytest.raises((RuntimeError, OverloadError)):
+            p.result(timeout=5)  # settled explicitly, one way or the other
+    assert srv.pending == 0
+
+
+def test_pending_done_callback_runs_once_and_contains_errors():
+    """add_done_callback: registered-then-settled and settled-then-
+    registered both fire exactly once; a raising callback is contained
+    (the settling thread survives)."""
+    from bitcoinconsensus_tpu.models.batch import BatchResult
+    from bitcoinconsensus_tpu.serving.server import PendingVerify
+
+    req = PendingVerify("item", "t", 0.0)
+    fired = []
+    req.add_done_callback(lambda r: fired.append("pre"))
+
+    def bad(_r):
+        raise RuntimeError("broken observer")
+
+    req.add_done_callback(bad)
+    req._resolve(BatchResult.success())  # must not raise despite `bad`
+    req._resolve(BatchResult.success())  # second settle: no-op, no refire
+    assert fired == ["pre"]
+    req.add_done_callback(lambda r: fired.append("post"))  # late: immediate
+    assert fired == ["pre", "post"]
+    assert req.result(timeout=1).ok
