@@ -1,0 +1,89 @@
+"""Adversarial and mainnet-shaped workload generation (the gauntlet).
+
+Three legs, all deterministic from explicit seeds (README "Adversarial
+workloads & gauntlet"):
+
+- ``corpus`` — constructed worst-case transactions (max-fan-out
+  CHECKMULTISIG, max-size scripts, pre-BIP143 quadratic sighash,
+  taproot script-path + annex, signature-malleation and boundary-flag
+  cases), each with a pinned expected verdict. The shapes the reference
+  names as the hard cases (SURVEY §7) and the ones where a batched
+  verifier can silently diverge or fall off its latency cliff.
+- ``replay`` — seed-driven realistic multi-block streams (mainnet-like
+  script-type mix, duplicate signers, mempool→block re-verification
+  for cache-warm patterns, varying batch fill, bursty tenant arrival)
+  driven end-to-end through ingress → coalescing → the stream driver,
+  asserted bit-identical against an independent host oracle.
+- ``diff_fuzz`` — seed-driven mutation of corpus entries run through
+  the pure-Python engine, the native C++ engine and the batch/device
+  driver, fail-closed on any triple disagreement.
+
+`scripts/consensus_gauntlet.py` is the CLI; `consensus_chaos.py
+--gauntlet` runs every leg under the fault sweep. Never imported by the
+production verify path.
+
+Gauntlet telemetry lives here so every leg shares one set of
+instruments (consensus_stats.py REQUIRED_METRICS carries them).
+"""
+
+from __future__ import annotations
+
+from ..obs import counter as _counter
+from ..obs import histogram as _histogram
+
+GAUNTLET_CORPUS_CASES = _counter(
+    "consensus_gauntlet_corpus_cases_total",
+    "adversarial corpus cases run, by shape",
+    ("shape",),
+)
+GAUNTLET_DIVERGENCE = _counter(
+    "consensus_gauntlet_divergence_total",
+    "gauntlet verdict divergences (corpus pin misses, replay oracle "
+    "mismatches, diff-fuzz backend disagreements) — any increment is a "
+    "consensus bug or a stale pin",
+    ("leg",),
+)
+GAUNTLET_REPLAY_BLOCKS = _counter(
+    "consensus_gauntlet_replay_blocks_total",
+    "replay-harness blocks streamed through the pipeline",
+)
+GAUNTLET_FUZZ_CASES = _counter(
+    "consensus_gauntlet_fuzz_cases_total",
+    "differential-fuzz mutated cases compared across backends",
+)
+GAUNTLET_SHAPE_SECONDS = _histogram(
+    "consensus_gauntlet_shape_seconds",
+    "per-item verify latency by adversarial shape (worst-case p99 "
+    "tracking; populated by the corpus/bench legs)",
+    ("shape",),
+    buckets=(1e-5, 1e-4, 1e-3, 1e-2, 1e-1, 1.0, 10.0),
+)
+
+from .corpus import CorpusCase, SHAPES, build_corpus, shape_batch  # noqa: E402
+from .replay import (  # noqa: E402
+    ReplayBlock,
+    ReplayConfig,
+    generate_stream,
+    run_replay,
+    run_replay_serving,
+)
+from .diff_fuzz import backend_verdicts, run_diff_fuzz  # noqa: E402
+
+__all__ = [
+    "CorpusCase",
+    "SHAPES",
+    "build_corpus",
+    "shape_batch",
+    "ReplayBlock",
+    "ReplayConfig",
+    "generate_stream",
+    "run_replay",
+    "run_replay_serving",
+    "backend_verdicts",
+    "run_diff_fuzz",
+    "GAUNTLET_CORPUS_CASES",
+    "GAUNTLET_DIVERGENCE",
+    "GAUNTLET_REPLAY_BLOCKS",
+    "GAUNTLET_FUZZ_CASES",
+    "GAUNTLET_SHAPE_SECONDS",
+]

@@ -1,0 +1,387 @@
+"""Historical-replay harness: mainnet-shaped streams through the pipeline.
+
+A deterministic, seed-driven generator of realistic multi-block
+workloads — mixed script types at mainnet-like ratios, duplicate
+signers, mempool→block re-verification (the cache-warm pattern
+production replay actually exhibits), varying batch fill and a sprinkle
+of invalid spends — plus drivers that push the stream end-to-end
+through each serving surface and assert the fail-closed contract:
+
+- `run_replay` — `verify_batch_stream` (the pipelined batch driver),
+  every verdict compared bit-identically against the independent
+  pure-Python host oracle, and the mempool→block overlap must actually
+  warm the script/sig caches.
+- `run_replay_serving` — the full path: per-tenant client threads in
+  bursts through `VerifyServer` (mode="serve") or over a real socket
+  through `IngressServer`/`IngressClient` (mode="ingress"). Every
+  submission ends in exactly one explicit outcome: a settled verdict
+  (oracle-checked) or an `OverloadError` shed; with `overload=True`
+  the config is tightened until sheds actually happen — and they must
+  all be explicit.
+
+`scripts/consensus_gauntlet.py --replay` is the CLI;
+`consensus_chaos.py --gauntlet` re-runs the stream leg under injected
+flips/stragglers/poison. Never imported by the production verify path.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+from ..core.flags import VERIFY_ALL_EXTENDED
+from ..models.batch import BatchItem, verify_batch_stream
+from ..models.sigcache import ScriptExecutionCache, SigCache
+from ..utils import blockgen
+
+__all__ = [
+    "ReplayConfig",
+    "ReplayBlock",
+    "generate_stream",
+    "run_replay",
+    "run_replay_serving",
+]
+
+# Mainnet-ish script-type ratios (input-count share, post-taproot era;
+# coarse on purpose — the point is MIXED traffic, not census accuracy).
+DEFAULT_MIX = (
+    ("p2wpkh", 0.55),
+    ("p2tr", 0.20),
+    ("p2pkh", 0.15),
+    ("p2wsh_multisig", 0.10),
+)
+
+
+@dataclass
+class ReplayConfig:
+    seed: int = 0
+    n_blocks: int = 4
+    txs_per_block: int = 6          # mean; actual fill varies ±50% per block
+    max_inputs: int = 3
+    mix: Tuple[Tuple[str, float], ...] = DEFAULT_MIX
+    dup_signer_rate: float = 0.35   # P(reuse an already-seen wallet)
+    mempool_fraction: float = 0.5   # share of a block pre-verified "in mempool"
+    invalid_rate: float = 0.15      # P(one corrupted input in a tx)
+    tenants: int = 3
+
+
+@dataclass
+class ReplayBlock:
+    """One block's worth of verification traffic: the mempool batch
+    (arrivals verified ahead of the block) and the block batch (every
+    input re-verified at connect time — the overlap is the cache-warm
+    pattern)."""
+
+    height: int
+    mempool_items: List[BatchItem]
+    block_items: List[BatchItem]
+    expected_ok: List[bool] = field(default_factory=list)  # per block item
+    n_txs: int = 0
+
+
+def _pick_kind(rng: random.Random, mix) -> str:
+    r = rng.random() * sum(w for _, w in mix)
+    for kind, w in mix:
+        r -= w
+        if r <= 0:
+            return kind
+    return mix[-1][0]
+
+
+def generate_stream(cfg: ReplayConfig) -> List[ReplayBlock]:
+    """Deterministic multi-block stream from `cfg.seed` (same seed, same
+    bytes — the chaos sweep and CI replays depend on it)."""
+    rng = random.Random(cfg.seed)
+    pool: Dict[str, List[blockgen.Wallet]] = {k: [] for k, _ in cfg.mix}
+    blocks: List[ReplayBlock] = []
+
+    def wallet(kind: str) -> blockgen.Wallet:
+        seen = pool[kind]
+        if seen and rng.random() < cfg.dup_signer_rate:
+            return rng.choice(seen)  # duplicate signer
+        w = blockgen.Wallet(f"replay/{cfg.seed}/{kind}/{len(seen)}", kind)
+        seen.append(w)
+        return w
+
+    for b in range(cfg.n_blocks):
+        lo = max(1, cfg.txs_per_block // 2)
+        n_txs = rng.randint(lo, cfg.txs_per_block + cfg.txs_per_block // 2)
+        block_items: List[BatchItem] = []
+        expected: List[bool] = []
+        mempool_cut: List[int] = []
+        for t in range(n_txs):
+            n_in = rng.randint(1, cfg.max_inputs)
+            funded = []
+            for i in range(n_in):
+                w = wallet(_pick_kind(rng, cfg.mix))
+                op = blockgen.OutPoint(
+                    blockgen.hashlib.sha256(
+                        f"replay/{cfg.seed}/{b}/{t}/{i}".encode()
+                    ).digest(),
+                    i,
+                )
+                amount = rng.randrange(10_000, 1_000_000)
+                funded.append(blockgen.FundedOutput(op, w, amount))
+            corrupt = (
+                rng.randrange(n_in) if rng.random() < cfg.invalid_rate else None
+            )
+            tx = blockgen.build_spend_tx(funded, corrupt_input=corrupt)
+            raw = tx.serialize()
+            outs = [(f.amount, f.wallet.spk) for f in funded]
+            start = len(block_items)
+            for i in range(n_in):
+                block_items.append(
+                    BatchItem(raw, i, VERIFY_ALL_EXTENDED, spent_outputs=outs)
+                )
+                expected.append(corrupt is None or i != corrupt)
+            if rng.random() < cfg.mempool_fraction:
+                mempool_cut.extend(range(start, len(block_items)))
+        blocks.append(
+            ReplayBlock(
+                height=100 + b,
+                mempool_items=[block_items[i] for i in mempool_cut],
+                block_items=block_items,
+                expected_ok=expected,
+                n_txs=n_txs,
+            )
+        )
+    return blocks
+
+
+def _oracle(items: List[BatchItem]) -> List[Tuple[bool, str, Optional[str]]]:
+    """Independent per-item host oracle (pure-Python engine; no caches,
+    no device, no batching) — the bit-identity reference."""
+    from .diff_fuzz import python_verdict
+
+    return [python_verdict(it) for it in items]
+
+
+def _triple(r) -> Tuple[bool, str, Optional[str]]:
+    from ..core.script_error import ScriptError
+
+    serr = (
+        r.script_error.name
+        if r.script_error is not None and r.script_error != ScriptError.OK
+        else None
+    )
+    return (r.ok, r.error.name, serr if not r.ok else None)
+
+
+def _norm(t: Tuple[bool, str, Optional[str]]) -> Tuple[bool, str, Optional[str]]:
+    ok, err, serr = t
+    return (ok, err, serr if not ok else None)
+
+
+def run_replay(cfg: ReplayConfig, depth: int = 2) -> dict:
+    """Drive the generated stream through `verify_batch_stream` against
+    fresh caches; returns a report with divergence and cache-warm counts
+    (both hard gauntlet criteria)."""
+    from . import (
+        GAUNTLET_DIVERGENCE,
+        GAUNTLET_REPLAY_BLOCKS,
+    )
+
+    blocks = generate_stream(cfg)
+    # Mempool validation runs ahead of block connection (as on mainnet);
+    # the lag must exceed the stream pipeline depth or the block batch's
+    # cache probe races the mempool batch's insert and the warm-up the
+    # harness is asserting never materialises.
+    lag = depth + 1
+    batches: List[List[BatchItem]] = []
+    expect_hits = 0
+    for i in range(len(blocks) + lag):
+        if i < len(blocks) and blocks[i].mempool_items:
+            batches.append(blocks[i].mempool_items)
+        if i >= lag:
+            blk = blocks[i - lag]
+            batches.append(blk.block_items)
+            if blk.mempool_items:
+                ok_idx = {
+                    j for j, ok in enumerate(blk.expected_ok) if ok
+                }
+                expect_hits += sum(
+                    1
+                    for j, it in enumerate(blk.block_items)
+                    if it in blk.mempool_items and j in ok_idx
+                )
+
+    sig_cache, script_cache = SigCache(), ScriptExecutionCache()
+    results = list(
+        verify_batch_stream(
+            batches, sig_cache=sig_cache, script_cache=script_cache,
+            depth=depth,
+        )
+    )
+
+    divergences: List[dict] = []
+    n_items = 0
+    for batch, res in zip(batches, results, strict=True):
+        oracle = _oracle(batch)
+        n_items += len(batch)
+        for j, (r, want) in enumerate(zip(res, oracle, strict=True)):
+            if _triple(r) != _norm(want):
+                divergences.append(
+                    {"batch_item": j, "got": _triple(r), "want": _norm(want)}
+                )
+    # Unconditional (a zero sample is the "leg ran, no divergence" fact
+    # the stats gate wants to see, not just the absence of a counter).
+    GAUNTLET_DIVERGENCE.inc(len(divergences), leg="replay")
+    GAUNTLET_REPLAY_BLOCKS.inc(len(blocks))
+
+    # Cache warm-up: every VALID mempool item re-verifies inside its
+    # block batch (the cache is success-only, so invalid overlap can
+    # never hit), so the script cache MUST have taken at least that many
+    # hits. Fewer means the mempool→block skip path silently died.
+    return {
+        "blocks": len(blocks),
+        "batches": len(batches),
+        "items": n_items,
+        "txs": sum(b.n_txs for b in blocks),
+        "mempool_overlap_items": sum(len(b.mempool_items) for b in blocks),
+        "expected_warm_hits": expect_hits,
+        "script_cache_hits": script_cache.hits,
+        "sig_cache_hits": sig_cache.hits,
+        "warmed": script_cache.hits >= expect_hits > 0,
+        "divergences": divergences,
+        "bit_identical": not divergences,
+    }
+
+
+def run_replay_serving(
+    cfg: ReplayConfig,
+    mode: str = "serve",
+    overload: bool = False,
+    timeout_s: float = 120.0,
+) -> dict:
+    """The full serving path: per-tenant threads submit the stream in
+    bursts. Every submission must end settled-and-oracle-identical or
+    explicitly shed — hangs, silent drops and mystery exceptions all
+    count as failures. With `overload=True` the server is configured so
+    sheds MUST happen (tiny tenant depth, no size flush)."""
+    assert mode in ("serve", "ingress")
+    from ..serving import (
+        IngressClient,
+        IngressServer,
+        OverloadError,
+        VerifyServer,
+    )
+    from . import GAUNTLET_DIVERGENCE
+
+    blocks = generate_stream(cfg)
+    items: List[BatchItem] = [
+        it for blk in blocks for it in blk.block_items
+    ]
+    oracle = [_norm(t) for t in _oracle(items)]
+
+    if overload:
+        server_kw = dict(max_batch=256, flush_s=0.05, tenant_depth=1)
+    else:
+        server_kw = dict(max_batch=16, flush_s=0.005, tenant_depth=256)
+
+    lanes = [(i, it) for i, it in enumerate(items)]
+    per_tenant: List[List[Tuple[int, BatchItem]]] = [
+        lanes[t :: cfg.tenants] for t in range(cfg.tenants)
+    ]
+
+    settled: Dict[int, Tuple[bool, str, Optional[str]]] = {}
+    sheds: List[int] = []
+    errors: List[str] = []
+    lock = threading.Lock()
+
+    def tenant_worker(t: int, submit) -> None:
+        rng = random.Random((cfg.seed << 8) | t)
+        work = per_tenant[t]
+        pos = 0
+        while pos < len(work):
+            burst = work[pos : pos + rng.randint(1, 4)]  # bursty arrival
+            pos += len(burst)
+            pendings = []
+            for idx, it in burst:
+                try:
+                    pendings.append((idx, submit(it, f"tenant{t}")))
+                except OverloadError:
+                    with lock:
+                        sheds.append(idx)
+                except Exception as e:  # noqa: BLE001 — trial accounting
+                    with lock:
+                        errors.append(f"submit[{idx}]: {e!r}")
+            for idx, pend in pendings:
+                try:
+                    res = pend.result(timeout=timeout_s) if pend is not None else None
+                    with lock:
+                        settled[idx] = _triple(res)
+                except Exception as e:  # noqa: BLE001
+                    with lock:
+                        errors.append(f"settle[{idx}]: {e!r}")
+
+    srv = VerifyServer(
+        sig_cache=SigCache(), script_cache=ScriptExecutionCache(),
+        **server_kw,
+    ).start()
+    ingress = None
+    clients: List[IngressClient] = []
+    try:
+        if mode == "ingress":
+            ingress = IngressServer(srv, idle_s=timeout_s).start()
+
+            def make_submit():
+                cli = IngressClient(port=ingress.port, timeout_s=timeout_s)
+                clients.append(cli)
+
+                def submit(it, tenant):
+                    # Socket path is synchronous: settle inline, return a
+                    # pre-resolved pending so the worker's settle loop is
+                    # uniform across modes.
+                    res = cli.verify(it, tenant)
+
+                    class _Done:
+                        def result(self, timeout=None):
+                            return res
+
+                    return _Done()
+
+                return submit
+
+            submits = [make_submit() for _ in range(cfg.tenants)]
+        else:
+            submits = [srv.submit for _ in range(cfg.tenants)]
+
+        threads = [
+            threading.Thread(target=tenant_worker, args=(t, submits[t]))
+            for t in range(cfg.tenants)
+        ]
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join(timeout=timeout_s)
+        hung = [th for th in threads if th.is_alive()]
+    finally:
+        for cli in clients:
+            cli.close()
+        if ingress is not None:
+            ingress.close(drain=True)
+        srv.close(drain=True)
+
+    divergences = [
+        {"item": idx, "got": got, "want": oracle[idx]}
+        for idx, got in sorted(settled.items())
+        if got != oracle[idx]
+    ]
+    GAUNTLET_DIVERGENCE.inc(len(divergences), leg="replay-serving")
+    all_accounted = len(settled) + len(sheds) == len(items)
+    return {
+        "mode": mode,
+        "items": len(items),
+        "settled": len(settled),
+        "sheds": len(sheds),
+        "errors": errors,
+        "hung_threads": len(hung),
+        "divergences": divergences,
+        "bit_identical": not divergences,
+        "all_accounted": all_accounted and not errors and not hung,
+        "sheds_expected": overload,
+        "sheds_happened": (len(sheds) > 0) if overload else True,
+        "sheds_explicit_only": all_accounted,
+    }

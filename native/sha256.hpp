@@ -13,7 +13,12 @@
 #include <cstdint>
 #include <cstring>
 
-#if defined(__x86_64__) && defined(__GNUC__)
+// __builtin_cpu_supports("sha") is only a valid feature string from
+// GCC 11 (clang has carried it longer); older GCC rejects it at compile
+// time, so the whole SHA-NI path is gated out there and the scalar
+// transform below serves every call.
+#if defined(__x86_64__) && defined(__GNUC__) && \
+    (defined(__clang__) || __GNUC__ >= 11)
 #define NAT_SHA_NI_POSSIBLE 1
 #include <immintrin.h>
 #endif

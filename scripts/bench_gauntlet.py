@@ -1,0 +1,193 @@
+#!/usr/bin/env python
+"""Per-shape worst-case benchmarks with provenance-keyed baselines.
+
+The perf-gate leg of the adversarial gauntlet: every benched corpus
+shape (`workloads.corpus.shape_batch`) — CHECKMULTISIG fan-out,
+pre-BIP143 quadratic sighash, max-size scripts, taproot script-path +
+annex — is driven through `verify_batch` on fresh caches and its
+throughput compared against the checked-in baseline for THIS hardware
+class in `GAUNTLET_BASELINES.json`.
+
+Baselines are a provenance-keyed list (`obs/perf.provenance()`, the
+PR-9 discipline): `--check` only compares against an entry whose
+platform/device kind match the current run and SKIPS cleanly when none
+does — a CPU container run can never flap a TPU worst-case baseline,
+and vice versa. `--measure` appends or replaces the entry for the
+current hardware class.
+
+    python scripts/bench_gauntlet.py                     # measure, print
+    python scripts/bench_gauntlet.py --measure           # update baseline file
+    python scripts/bench_gauntlet.py --check             # CI regression gate
+    python scripts/bench_gauntlet.py --check --out G.json  # + artifact
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+if "XLA_FLAGS" not in os.environ:
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BASELINES_PATH = os.path.join(ROOT, "GAUNTLET_BASELINES.json")
+
+# Smoke-sized per-shape batch counts (overridable via --n): big enough
+# that the device path engages, small enough for a CI shard. The
+# quadratic shape's count is its INPUT count — one n-input legacy tx,
+# so hashing work grows quadratically in it by construction.
+DEFAULT_COUNTS = {
+    "multisig_fanout": 16,
+    "quadratic_sighash": 16,
+    "max_size_script": 8,
+    "taproot_annex": 32,
+}
+
+
+def bench_shapes(counts, iters: int = 3) -> dict:
+    from bitcoinconsensus_tpu.models.batch import verify_batch
+    from bitcoinconsensus_tpu.models.sigcache import (
+        ScriptExecutionCache,
+        SigCache,
+    )
+    from bitcoinconsensus_tpu.workloads import GAUNTLET_SHAPE_SECONDS
+    from bitcoinconsensus_tpu.workloads.corpus import shape_batch
+
+    shapes = {}
+    for shape, n in sorted(counts.items()):
+        items = shape_batch(shape, n, seed=0)
+
+        def run():
+            res = verify_batch(
+                items,
+                sig_cache=SigCache(),
+                script_cache=ScriptExecutionCache(),
+            )
+            bad = [i for i, r in enumerate(res) if not r.ok]
+            assert not bad, f"{shape}: bench items failed at {bad}"
+
+        run()  # warm the jit/compile caches; timed passes are steady-state
+        best = min(_timed(run) for _ in range(iters))
+        GAUNTLET_SHAPE_SECONDS.observe(best / len(items), shape=shape)
+        shapes[shape] = {
+            "items": len(items),
+            "best_s": best,
+            "items_per_sec": len(items) / best,
+            "per_item_s": best / len(items),
+        }
+    return shapes
+
+
+def _timed(fn) -> float:
+    t0 = time.perf_counter()
+    fn()
+    return time.perf_counter() - t0
+
+
+def load_baselines() -> dict:
+    if not os.path.exists(BASELINES_PATH):
+        return {"baselines": []}
+    with open(BASELINES_PATH, encoding="utf-8") as fh:
+        return json.load(fh)
+
+
+def find_comparable(doc: dict, prov: dict):
+    from bitcoinconsensus_tpu.obs.perf import comparable
+
+    for entry in doc.get("baselines", []):
+        ok, _why = comparable(entry.get("provenance", {}), prov)
+        if ok:
+            return entry
+    return None
+
+
+def check_against_baseline(entry: dict, shapes: dict,
+                           tolerance: float) -> list:
+    """Per-shape throughput gate; relative drop beyond `tolerance`
+    regresses (same shape as obs/perf.compare_reports throughput leg)."""
+    problems = []
+    for shape, base in sorted(entry.get("shapes", {}).items()):
+        cur = shapes.get(shape)
+        if cur is None:
+            problems.append(f"shape '{shape}' missing from current run")
+            continue
+        old_tp, new_tp = base.get("items_per_sec"), cur["items_per_sec"]
+        if old_tp and new_tp < old_tp * (1.0 - tolerance):
+            problems.append(
+                f"worst-case shape '{shape}' regression: "
+                f"{new_tp:.1f} items/s vs baseline {old_tp:.1f} "
+                f"(tolerance {tolerance:.0%})"
+            )
+    return problems
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--measure", action="store_true",
+                    help="write/replace this hardware class's entry in "
+                    "GAUNTLET_BASELINES.json")
+    ap.add_argument("--check", action="store_true",
+                    help="regression-gate against the comparable baseline "
+                    "entry; skip cleanly when none matches")
+    ap.add_argument("--n", type=int, default=0,
+                    help="override every shape's batch count")
+    ap.add_argument("--iters", type=int, default=3)
+    ap.add_argument("--tolerance", type=float, default=0.5)
+    ap.add_argument("--out", metavar="PATH",
+                    help="write the measured report to this path")
+    args = ap.parse_args(argv)
+
+    from bitcoinconsensus_tpu.obs.perf import provenance
+
+    counts = dict(DEFAULT_COUNTS)
+    if args.n:
+        counts = {k: args.n for k in counts}
+    prov = provenance()
+    shapes = bench_shapes(counts, iters=args.iters)
+    report = {"shapes": shapes, "provenance": prov}
+    doc = json.dumps(report, indent=2)
+    if args.out:
+        with open(args.out, "w", encoding="utf-8") as fh:
+            fh.write(doc + "\n")
+    print(doc)
+
+    if args.measure:
+        baselines = load_baselines()
+        entry = find_comparable(baselines, prov)
+        if entry is None:
+            baselines["baselines"].append(report)
+        else:
+            entry["shapes"] = shapes
+            entry["provenance"] = prov
+        with open(BASELINES_PATH, "w", encoding="utf-8") as fh:
+            fh.write(json.dumps(baselines, indent=2) + "\n")
+        print(f"# baseline written for {prov['platform']}/"
+              f"{prov['device_kind']}", file=sys.stderr)
+
+    if args.check:
+        entry = find_comparable(load_baselines(), prov)
+        if entry is None:
+            print(
+                "# no comparable baseline for "
+                f"{prov['platform']}/{prov['device_kind']} — check "
+                "skipped (a mismatched container can never flap a "
+                "worst-case baseline)",
+                file=sys.stderr,
+            )
+            return 0
+        problems = check_against_baseline(entry, shapes, args.tolerance)
+        for p in problems:
+            print(f"PROBLEM: {p}", file=sys.stderr)
+        print(f"# {len(entry['shapes'])} shapes gated, "
+              f"{len(problems)} problems", file=sys.stderr)
+        return 1 if problems else 0
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -64,6 +64,7 @@ Usage:
     python scripts/consensus_chaos.py --report chaos.json # write report
     python scripts/consensus_chaos.py --mesh --check      # shard-domain sweep
     python scripts/consensus_chaos.py --serve --check     # serving sweep
+    python scripts/consensus_chaos.py --gauntlet --check  # adversarial gauntlet
 """
 
 from __future__ import annotations
@@ -1670,6 +1671,192 @@ def run_ingress_sweep(seed: int) -> dict:
             "overhead": overhead}
 
 
+def _gauntlet_replay_trial(name, cfg, specs, seed, audit=False):
+    """Mainnet-shaped replay stream (workloads/replay.py) with a fault
+    armed: verdicts must stay bit-identical to the host oracle AND the
+    mempool→block cache warm-up must still materialise — containment may
+    cost retries, never correctness or the skip path."""
+    from bitcoinconsensus_tpu.resilience import (
+        FaultPlan,
+        inject,
+        set_cache_audit,
+    )
+    from bitcoinconsensus_tpu.resilience.guards import CACHE_POISON_CAUGHT
+    from bitcoinconsensus_tpu.workloads import run_replay
+
+    caught0 = CACHE_POISON_CAUGHT.value(cache="sig")
+    if audit:
+        set_cache_audit(True)
+    try:
+        with inject(FaultPlan(specs), seed=seed) as inj:
+            rep = run_replay(cfg)
+    finally:
+        if audit:
+            set_cache_audit(False)
+    trial = {
+        "trial": name,
+        "bit_identical": rep["bit_identical"],
+        "replay_warmed": rep["warmed"],
+        "blocks": rep["blocks"],
+        "items": rep["items"],
+        "script_cache_hits": rep["script_cache_hits"],
+    }
+    if specs:
+        trial["fired"] = {
+            f"{s}:{k}": c for (s, k), c in sorted(inj.fired.items())
+        }
+        trial["fault_fired"] = inj.total_fired() >= 1
+    if audit:
+        trial["poison_caught_by_audit"] = (
+            CACHE_POISON_CAUGHT.value(cache="sig") > caught0
+        )
+    return trial
+
+
+def _gauntlet_serving_trial(name, cfg, mode, specs, seed, overload=False):
+    """Replay pushed through the live serving path (VerifyServer or the
+    socket ingress) under an armed fault: every submission settles
+    bit-identical or sheds explicitly — hangs and silent drops fail."""
+    from bitcoinconsensus_tpu.resilience import FaultPlan, inject
+    from bitcoinconsensus_tpu.workloads import run_replay_serving
+
+    with inject(FaultPlan(specs), seed=seed) as inj:
+        rep = run_replay_serving(cfg, mode=mode, overload=overload)
+    trial = {
+        "trial": name,
+        "bit_identical": rep["bit_identical"],
+        "all_accounted": rep["all_accounted"],
+        "sheds_explicit_only": rep["sheds_explicit_only"],
+        "sheds_happened": rep["sheds_happened"],
+        "settled": rep["settled"],
+        "sheds": rep["sheds"],
+        "errors": rep["errors"][:5],
+    }
+    if specs:
+        trial["fired"] = {
+            f"{s}:{k}": c for (s, k), c in sorted(inj.fired.items())
+        }
+        trial["fault_fired"] = inj.total_fired() >= 1
+    return trial
+
+
+def _gauntlet_corpus_trial(name, specs, seed):
+    """All pinned adversarial corpus entries on every available engine,
+    optionally with a fault armed — the pins must hold either way."""
+    from bitcoinconsensus_tpu.resilience import FaultPlan, inject
+    from bitcoinconsensus_tpu.workloads.corpus import run_corpus_check
+
+    with inject(FaultPlan(specs), seed=seed) as inj:
+        rep = run_corpus_check()
+    trial = {
+        "trial": name,
+        "bit_identical": rep["pinned"],
+        "corpus_pinned": rep["pinned"],
+        "cases": rep["cases"],
+        "native_available": rep["native_available"],
+        "mismatches": rep["mismatches"][:5],
+    }
+    if specs:
+        trial["fired"] = {
+            f"{s}:{k}": c for (s, k), c in sorted(inj.fired.items())
+        }
+        trial["fault_fired"] = inj.total_fired() >= 1
+    return trial
+
+
+def _gauntlet_fuzz_trial(min_cases):
+    """Differential fuzz over the checked-in CI seed set: >= `min_cases`
+    mutants through every engine, zero unexplained divergence."""
+    from bitcoinconsensus_tpu.workloads import run_diff_fuzz
+
+    seeds_path = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "fuzz", "gauntlet_seeds.json",
+    )
+    with open(seeds_path, encoding="utf-8") as fh:
+        seeds = json.load(fh)["seeds"]
+    per_seed = -(-min_cases // len(seeds))  # ceil div
+    runs = [run_diff_fuzz(seed=s, n_cases=per_seed) for s in seeds]
+    divergences = [d for r in runs for d in r["divergences"]]
+    cases = sum(r["cases"] for r in runs)
+    return {
+        "trial": "gauntlet-diff-fuzz",
+        "bit_identical": not divergences,
+        "fuzz_zero_divergence": not divergences,
+        "fuzz_cases_ok": cases >= min_cases,
+        "cases": cases,
+        "seeds": seeds,
+        "engines": runs[0]["engines"],
+        "divergences": divergences[:5],
+    }
+
+
+def run_gauntlet_sweep(seed: int, fuzz_cases: int = 500) -> dict:
+    """The adversarial gauntlet under fault injection: the replay stream
+    end-to-end (batch stream, live server, socket ingress) under three
+    distinct fault classes, corpus pins clean and under verdict
+    corruption, the >=500-case differential-fuzz leg, and the standard
+    disarmed-hook overhead budget."""
+    from bitcoinconsensus_tpu.models.batch import verify_batch
+    from bitcoinconsensus_tpu.resilience import FaultSpec
+    from bitcoinconsensus_tpu.utils import blockgen
+    from bitcoinconsensus_tpu.workloads import ReplayConfig
+
+    cfg = ReplayConfig(seed=seed, n_blocks=4, txs_per_block=4)
+    small = ReplayConfig(seed=seed + 1, n_blocks=2, txs_per_block=3)
+
+    trials = [
+        _gauntlet_replay_trial("gauntlet-replay-clean", cfg, [], seed),
+        # Three fault classes against the same stream: device verdict
+        # corruption, dispatch failure, cache poisoning (audit armed).
+        _gauntlet_replay_trial(
+            "gauntlet-replay-verdict-flip", cfg,
+            [FaultSpec("jax_backend.verdict", "flip")], seed,
+        ),
+        _gauntlet_replay_trial(
+            "gauntlet-replay-dispatch-raise", cfg,
+            [FaultSpec("jax_backend.dispatch", "raise")], seed,
+        ),
+        # Persistent poison (count=64): a single fabricated hit can land
+        # on a probe whose true answer is ACCEPT (harmless by luck);
+        # firing across the stream guarantees some poisoned hits cover
+        # the invalid spends, which audit mode MUST catch.
+        _gauntlet_replay_trial(
+            "gauntlet-replay-cache-poison", cfg,
+            [FaultSpec("sigcache.sig", "poison", count=64)], seed,
+            audit=True,
+        ),
+        # The same traffic through the full serving path under faults,
+        # and a clean overload run that must shed explicitly.
+        _gauntlet_serving_trial(
+            "gauntlet-serve-dispatch-raise", small, "serve",
+            [FaultSpec("jax_backend.dispatch", "raise")], seed,
+        ),
+        _gauntlet_serving_trial(
+            "gauntlet-ingress-verdict-flip", small, "ingress",
+            [FaultSpec("jax_backend.verdict", "flip")], seed,
+        ),
+        _gauntlet_serving_trial(
+            "gauntlet-overload-explicit-sheds", small, "serve", [], seed,
+            overload=True,
+        ),
+        _gauntlet_corpus_trial("gauntlet-corpus-pins", [], seed),
+        _gauntlet_corpus_trial(
+            "gauntlet-corpus-verdict-flip",
+            [FaultSpec("jax_backend.verdict", "flip")], seed,
+        ),
+        _gauntlet_fuzz_trial(fuzz_cases),
+    ]
+
+    _view, funded = blockgen.make_funded_view(4, seed="gauntlet")
+    items = _batch_items(funded)
+    sig_cache, script_cache = _fresh_caches()
+    verify_batch(items, sig_cache=sig_cache, script_cache=script_cache)
+    overhead = _overhead_budget(items)
+    return {"seed": seed, "gauntlet": True, "trials": trials,
+            "overhead": overhead}
+
+
 def _problems(report: dict) -> list:
     probs = []
     for t in report["trials"]:
@@ -1701,7 +1888,11 @@ def _problems(report: dict) -> list:
                     "poison_evicted_durably", "corrupt_skipped",
                     "logs_healed", "fail_closed_misses_only",
                     "still_serving", "load_fault_contained",
-                    "append_fault_contained"):
+                    "append_fault_contained",
+                    # gauntlet sweep hard criteria
+                    "replay_warmed", "all_accounted",
+                    "sheds_explicit_only", "corpus_pinned",
+                    "fuzz_zero_divergence", "fuzz_cases_ok"):
             if t.get(key) is False:
                 probs.append(f"{t['trial']}: {key} is False")
     ov = report["overhead"]
@@ -1735,9 +1926,20 @@ def main(argv=None) -> int:
                     "sweep: hostile sockets, wire faults, overload sheds "
                     "over the wire, and kill-and-restart replay with a "
                     "poisoned persisted entry")
+    ap.add_argument("--gauntlet", action="store_true",
+                    help="run the adversarial workload gauntlet under "
+                    "fault injection: mainnet-shaped replay end-to-end "
+                    "through batch stream + server + ingress under 3 "
+                    "fault classes, corpus verdict pins on every engine, "
+                    "and the >=500-case differential-fuzz leg")
+    ap.add_argument("--fuzz-cases", type=int, default=500,
+                    help="minimum mutated cases for the gauntlet fuzz "
+                    "leg (default: 500)")
     args = ap.parse_args(argv)
 
-    if args.ingress:
+    if args.gauntlet:
+        report = run_gauntlet_sweep(args.seed, fuzz_cases=args.fuzz_cases)
+    elif args.ingress:
         report = run_ingress_sweep(args.seed)
     elif args.serve:
         report = run_serve_sweep(args.seed)

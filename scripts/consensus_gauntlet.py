@@ -1,0 +1,203 @@
+#!/usr/bin/env python
+"""Adversarial workload gauntlet: replay, corpus pins, differential fuzz.
+
+The clean-run driver for `bitcoinconsensus_tpu.workloads` (the fault-swept
+variant is `consensus_chaos.py --gauntlet`). Three legs, all
+deterministic from `--seed`:
+
+    replay   mainnet-shaped multi-block streams (mixed script types,
+             duplicate signers, mempool→block re-verification, bursty
+             tenants) through `verify_batch_stream`, a live VerifyServer
+             and the socket ingress — every verdict bit-identical to the
+             independent host oracle, the mempool→block overlap must
+             actually warm the script cache, and overload sheds only
+             explicitly.
+    corpus   every pinned worst-case entry (workloads/corpus.py) on every
+             available engine — python, native C++, batch/device — must
+             reproduce its pinned (ok, Error, ScriptError) verdict.
+    fuzz     seed-driven mutation of corpus entries through the same
+             engines, fail-closed on any disagreement. CI seeds live in
+             fuzz/gauntlet_seeds.json so failures replay exactly.
+
+Usage:
+    python scripts/consensus_gauntlet.py                    # all legs, small
+    python scripts/consensus_gauntlet.py --replay           # one leg
+    python scripts/consensus_gauntlet.py --corpus
+    python scripts/consensus_gauntlet.py --fuzz 500
+    python scripts/consensus_gauntlet.py --check            # CI gate
+    python scripts/consensus_gauntlet.py --report out.json  # artifact
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# Match tests/conftest.py so the persistent XLA compile cache is shared
+# (device count is part of the cache key); must precede jax init.
+if "XLA_FLAGS" not in os.environ:
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+
+SEEDS_PATH = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "fuzz",
+    "gauntlet_seeds.json",
+)
+
+
+def ci_fuzz_seeds() -> list:
+    """The checked-in seed set (fuzz/gauntlet_seeds.json) — fixed so a CI
+    failure reproduces exactly from the artifact alone."""
+    with open(SEEDS_PATH, encoding="utf-8") as fh:
+        return json.load(fh)["seeds"]
+
+
+def run_replay_leg(seed: int, blocks: int, txs: int) -> dict:
+    from bitcoinconsensus_tpu.workloads import (
+        ReplayConfig,
+        run_replay,
+        run_replay_serving,
+    )
+
+    cfg = ReplayConfig(seed=seed, n_blocks=blocks, txs_per_block=txs)
+    stream = run_replay(cfg)
+    small = ReplayConfig(seed=seed + 1, n_blocks=2, txs_per_block=3)
+    serve = run_replay_serving(small, mode="serve")
+    shed = run_replay_serving(small, mode="serve", overload=True)
+    ingress = run_replay_serving(small, mode="ingress")
+    ok = all(
+        (
+            stream["bit_identical"],
+            stream["warmed"],
+            serve["bit_identical"],
+            serve["all_accounted"],
+            shed["bit_identical"],
+            shed["all_accounted"],
+            shed["sheds_happened"],
+            ingress["bit_identical"],
+            ingress["all_accounted"],
+        )
+    )
+    return {
+        "ok": ok,
+        "stream": stream,
+        "serving": serve,
+        "overload": shed,
+        "ingress": ingress,
+    }
+
+
+def run_corpus_leg() -> dict:
+    from bitcoinconsensus_tpu.workloads.corpus import run_corpus_check
+
+    rep = run_corpus_check()
+    rep["ok"] = rep["pinned"]
+    return rep
+
+
+def run_fuzz_leg(seeds, n_cases: int) -> dict:
+    from bitcoinconsensus_tpu.workloads import run_diff_fuzz
+
+    per_seed = max(1, n_cases // len(seeds))
+    runs = [run_diff_fuzz(seed=s, n_cases=per_seed) for s in seeds]
+    divergences = [d for r in runs for d in r["divergences"]]
+    return {
+        "ok": not divergences,
+        "seeds": list(seeds),
+        "cases": sum(r["cases"] for r in runs),
+        "engines": runs[0]["engines"],
+        "native_available": runs[0]["native_available"],
+        "divergences": divergences,
+    }
+
+
+def _problems(report: dict) -> list:
+    probs = []
+    for leg, rep in report["legs"].items():
+        if not rep["ok"]:
+            probs.append(f"{leg}: leg failed")
+        for sub in ("stream", "serving", "overload", "ingress"):
+            r = rep.get(sub)
+            if r is None:
+                continue
+            if not r.get("bit_identical", True):
+                probs.append(f"{leg}.{sub}: diverged from host oracle")
+            if r.get("warmed") is False:
+                probs.append(f"{leg}.{sub}: mempool→block cache warm-up missing")
+            if r.get("all_accounted") is False:
+                probs.append(f"{leg}.{sub}: silent drop/hang (not all accounted)")
+            if r.get("sheds_happened") is False:
+                probs.append(f"{leg}.{sub}: overload never shed")
+        for d in rep.get("mismatches", []):
+            probs.append(
+                f"corpus pin miss: {d['case']} [{d['engine']}] "
+                f"want {d['want']} got {d['got']}"
+            )
+        for d in rep.get("divergences", []):
+            probs.append(
+                f"fuzz divergence: case {d['case']} ({d['origin']}, "
+                f"{d['mutation']}): {d['verdicts']}"
+            )
+    return probs
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--replay", action="store_true", help="replay leg only")
+    ap.add_argument("--corpus", action="store_true", help="corpus leg only")
+    ap.add_argument("--fuzz", type=int, metavar="N", default=0,
+                    help="fuzz leg only, with N mutated cases")
+    ap.add_argument("--blocks", type=int, default=4,
+                    help="replay blocks (default: 4)")
+    ap.add_argument("--txs", type=int, default=4,
+                    help="mean txs per replay block (default: 4)")
+    ap.add_argument("--check", action="store_true",
+                    help="exit 1 on any divergence, pin miss, missing "
+                    "warm-up or non-explicit shed")
+    ap.add_argument("--report", metavar="PATH",
+                    help="write the JSON gauntlet report to this path")
+    args = ap.parse_args(argv)
+
+    all_legs = not (args.replay or args.corpus or args.fuzz)
+    t0 = time.time()
+    legs = {}
+    if args.replay or all_legs:
+        legs["replay"] = run_replay_leg(args.seed, args.blocks, args.txs)
+    if args.corpus or all_legs:
+        legs["corpus"] = run_corpus_leg()
+    if args.fuzz or all_legs:
+        n = args.fuzz or 150
+        legs["fuzz"] = run_fuzz_leg(ci_fuzz_seeds(), n)
+
+    report = {
+        "seed": args.seed,
+        "wall_s": round(time.time() - t0, 3),
+        "legs": legs,
+    }
+    probs = _problems(report)
+    report["problems"] = probs
+    doc = json.dumps(report, indent=2)
+    if args.report:
+        with open(args.report, "w", encoding="utf-8") as fh:
+            fh.write(doc + "\n")
+    print(doc)
+    print(
+        f"# gauntlet: {len(legs)} legs in {report['wall_s']:.1f}s, "
+        f"{len(probs)} problems",
+        file=sys.stderr,
+    )
+    if args.check and probs:
+        for p in probs:
+            print(f"PROBLEM: {p}", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

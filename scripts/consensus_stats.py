@@ -120,6 +120,14 @@ REQUIRED_METRICS = [
     "consensus_sigstore_warmup_seconds",
     "consensus_sigstore_replay_records_total",
     "consensus_sigstore_appends_total",
+    # adversarial gauntlet (workloads/: corpus pins, replay stream,
+    # differential fuzz; the divergence counter reports explicit zero
+    # samples per leg — "ran and agreed", not merely "absent")
+    "consensus_gauntlet_corpus_cases_total",
+    "consensus_gauntlet_divergence_total",
+    "consensus_gauntlet_replay_blocks_total",
+    "consensus_gauntlet_fuzz_cases_total",
+    "consensus_gauntlet_shape_seconds",
     # spans
     "consensus_span_duration_seconds",
 ]
@@ -293,6 +301,24 @@ def run_mini_workload() -> None:
         res2, verdict2 = sv2.verify_checks_with_verdict(checks)
     assert verdict2 and res2.all()
     assert int(sv2.mesh.devices.size) == 7  # survivor mesh kept flowing
+
+    # --- adversarial gauntlet: a tiny replay stream, the pinned corpus
+    # sweep (per-shape latency histogram) and a handful of fuzz mutants
+    # light the consensus_gauntlet_* family with its zero-divergence
+    # samples ---
+    from bitcoinconsensus_tpu.workloads import (
+        ReplayConfig,
+        run_diff_fuzz,
+        run_replay,
+    )
+    from bitcoinconsensus_tpu.workloads.corpus import run_corpus_check
+
+    grep = run_replay(ReplayConfig(seed=5, n_blocks=2, txs_per_block=2))
+    assert grep["bit_identical"], grep["divergences"]
+    crep = run_corpus_check()
+    assert crep["pinned"], crep["mismatches"]
+    frep = run_diff_fuzz(seed=1, n_cases=8)
+    assert frep["bit_identical"], frep["divergences"]
 
 
 def main(argv=None) -> int:
