@@ -18,6 +18,12 @@ flows through `bitcoinconsensus_tpu.obs` spans — the one sanctioned
 clock reader — so ad-hoc `time.perf_counter()` pairs cannot drift in
 beside the uniform telemetry.
 
+The `precision` rule group runs alone over `ops/` and `crypto/`: every
+`jnp.dot` / `lax.dot_general` there must pin
+`precision=lax.Precision.HIGHEST` at the call site — the source-level
+complement of the jaxpr prover's dot rule, catching the bug before
+tracing and in paths no registered kernel reaches yet.
+
 Pure-AST checks: no imports of the scanned modules, so a syntax-valid
 file is lintable even when its dependencies are not importable.
 """
@@ -51,6 +57,18 @@ SYNC_BANNED_CALLS = {
     ("np", "array"), ("numpy", "array"),
     ("jax", "device_get"),
 }
+# MXU precision discipline: every dot in the traced consensus ops must
+# pin `precision=lax.Precision.HIGHEST` explicitly — the TPU MXU lowers
+# default-precision f32 dots through bfloat16 passes (8-bit mantissa)
+# that silently truncate 13-bit limbs. The jaxpr prover catches this
+# after tracing (interval._r_dot); this catches it at review time, and
+# in code paths no registered kernel reaches yet.
+PRECISION_RULES = frozenset({"precision"})
+# module-path suffixes whose calls take a precision keyword.
+DOT_CALLS = {"jnp.dot", "jax.numpy.dot", "numpy.dot",
+             "lax.dot_general", "jax.lax.dot_general",
+             "jnp.matmul", "jax.numpy.matmul"}
+
 # Pallas kernel-body discipline: inside `_kernel_body`, every limb
 # constant must come through the consts_ref row table installed by
 # `_kernel`'s set_const_provider — materializing an ndarray there makes
@@ -90,6 +108,18 @@ class LintFinding:
 
 def _is_float_literal(node: ast.Constant) -> bool:
     return isinstance(node.value, float)
+
+
+def _dotted_name(fn) -> str:
+    """`a.b.c` attribute chain -> \"a.b.c\"; anything else -> \"\"."""
+    parts = []
+    while isinstance(fn, ast.Attribute):
+        parts.append(fn.attr)
+        fn = fn.value
+    if isinstance(fn, ast.Name):
+        parts.append(fn.id)
+        return ".".join(reversed(parts))
+    return ""
 
 
 class _Visitor(ast.NodeVisitor):
@@ -140,6 +170,21 @@ class _Visitor(ast.NodeVisitor):
 
     def visit_Call(self, node: ast.Call):
         fn = node.func
+        if "precision" in self.rules:
+            name = _dotted_name(fn)
+            if name in DOT_CALLS:
+                kw = next((k.value for k in node.keywords
+                           if k.arg == "precision"), None)
+                if not (isinstance(kw, ast.Attribute)
+                        and kw.attr == "HIGHEST"):
+                    self._flag(
+                        node, "dot-precision",
+                        f"{name}() without an explicit "
+                        "precision=lax.Precision.HIGHEST — the TPU MXU "
+                        "lowers default-precision f32 dots through "
+                        "bfloat16 passes that silently truncate 13-bit "
+                        "limbs; the exactness theorem only holds at "
+                        "HIGHEST")
         if "pallas" in self.rules and self._in_kernel_body():
             name = None
             if (isinstance(fn, ast.Attribute)
@@ -250,6 +295,11 @@ def lint_consensus_host(repo_root: str) -> List[LintFinding]:
                           rules=TIMING_RULES)
     findings += lint_paths([os.path.join(pkg, "ops", "pallas_kernel.py")],
                            rules=PALLAS_RULES)
+    # MXU precision discipline over the traced consensus ops: every dot
+    # must pin Precision.HIGHEST at the call site (see PRECISION_RULES).
+    findings += lint_paths([os.path.join(pkg, "ops"),
+                            os.path.join(pkg, "crypto")],
+                           rules=PRECISION_RULES)
     # Async-dispatch discipline over the in-flight pipeline: the dispatch
     # drivers and the queue itself must not force device buffers to host
     # outside the settle seam (see SYNC_ALLOWED_FUNCS).

@@ -34,11 +34,17 @@ Precision machinery (needed to prove the real kernels, not toys):
   along-axis-0 flag; `reduce_sum(table * onehot, axis=0)` then joins
   rows instead of summing them — without this the windowed scalar-mult
   table selects false-alarm by a factor of the table size.
-- Exact-float discipline: float32 values are legal only while provably
-  integer-valued with magnitude <= 2^24 (exact in an f32 mantissa) and
-  only through converts / HIGHEST-precision dots — the MXU one-hot row
-  select of `ops/curve._fixed_base_mult`. Any other float use is a
-  violation.
+- Exact-float discipline: every float32 value carries an exactness
+  CERTIFICATE (exactf + a tracked magnitude bound <= 2^24, exact in an
+  f32 mantissa), propagated end to end through converts, one-hot
+  construction, select_n, mul/add, reductions and HIGHEST-precision
+  dots — where the sound rule is the ACCUMULATED bound
+  Sum|terms| <= 2^24, not the result hull. A primitive outside
+  FLOAT_VETTED demotes the certificate with a sourced diagnostic
+  (`fwhy`), and an inexact f32 reaching a use site or a kernel output
+  is a violation. Every float equation is appended to the report's
+  `exactness` trace — the machine-checkable theorem the MXU one-hot
+  fe_mul candidate and the gtable selects are certified by.
 - Loops: `scan` (what `fori_loop` lowers to) and fori-shaped `while`
   run to an abstract fixpoint with staged widening; `while` with a
   data-dependent trip count is rejected outright (determinism gate).
@@ -67,6 +73,7 @@ __all__ = [
     "analyze",
     "analyze_closed",
     "ALLOWED_PRIMITIVES",
+    "FLOAT_VETTED",
 ]
 
 # Saturation sentinel: "unbounded" true value. Big enough that no real
@@ -123,13 +130,18 @@ class AbstractArray:
            past ROW_CAP, where per-row cells can no longer express it).
     exactf: float dtype carrying exactly-representable integers
             (|v| <= 2^24); non-exact floats are violations at use sites.
+    fwhy: for a float value with exactf=False, the sourced reason the
+          exactness certificate was lost (the demoting equation). None
+          for exact floats and non-floats. Carried so the eventual
+          violation (at a use site or the kernel output) can name the
+          equation that actually broke the chain, not just the symptom.
     """
 
     __slots__ = ("shape", "dtype", "cells", "nz0", "uni0", "dist0",
-                 "exactf", "poly")
+                 "exactf", "fwhy", "poly")
 
     def __init__(self, shape, dtype, cells, nz0=False, uni0=False,
-                 exactf=False, dist0=False, poly=None):
+                 exactf=False, dist0=False, poly=None, fwhy=None):
         self.shape = tuple(shape)
         self.dtype = np.dtype(dtype)
         self.cells = cells  # list[r0] of list[r1] of (lo, hi)
@@ -137,6 +149,7 @@ class AbstractArray:
         self.uni0 = uni0
         self.dist0 = dist0
         self.exactf = exactf
+        self.fwhy = fwhy
         # Optional sum-of-products refinement (see _poly_transfer): dict
         # monomial -> {row_or_None: int coeff}. Sound per-cell true-value
         # decomposition over interval atoms; used to recover correlations
@@ -303,6 +316,13 @@ class Report:
     wrap_eqns: int = 0      # signed ring ops whose interval left int32
     max_observed: int = 0   # largest |bound| proven at an observation
     notes: List[str] = field(default_factory=list)
+    # Exact-float theorem trace: one entry per float-dtyped equation
+    # output (unmuted passes), recording the primitive, the proven
+    # magnitude bound, whether the exactness certificate survived, and —
+    # for dot_general / reduce_sum — the accumulated sum-of-|terms|
+    # bound actually checked against 2^24. This is the machine-checkable
+    # per-value bound trace the report JSON exports.
+    exactness: List[dict] = field(default_factory=list)
     # Pallas-layer facts (analysis/pallas_check.py): peak VMEM live set
     # of the kernel (blocks + scratch + intermediates) and the grid shape.
     vmem_peak_bytes: Optional[int] = None
@@ -328,11 +348,16 @@ class Report:
             ],
             "notes": self.notes,
         }
+        if self.exactness:
+            d["exactness"] = self.exactness
         if self.vmem_peak_bytes is not None:
             d["vmem_peak_bytes"] = int(self.vmem_peak_bytes)
         if self.grid is not None:
             d["grid"] = [int(g) for g in self.grid]
         return d
+
+
+_TRACE_CAP = 4096  # exactness-trace entries per report (overflow noted)
 
 
 class _Ctx:
@@ -345,12 +370,27 @@ class _Ctx:
         # strong updates to hull-merges here: the body may abstract more
         # than one concrete execution.
         self.in_loop = 0
+        # Scratchpad cleared before each equation: transfer rules drop
+        # facts here (e.g. dot_general's accumulated sum bound) and the
+        # float post-pass folds them into the exactness-trace entry.
+        self.eqn_facts: Dict[str, object] = {}
 
     def violate(self, kind: str, where: str, msg: str):
         if self.mute:
             return
         self.report.ok = False
         self.report.violations.append(Violation(kind, where, msg))
+
+    def trace_float(self, entry: dict):
+        if self.mute:
+            return
+        tr = self.report.exactness
+        if len(tr) >= _TRACE_CAP:
+            if len(tr) == _TRACE_CAP:
+                tr.append({"note": f"exactness trace capped at "
+                                   f"{_TRACE_CAP} entries"})
+            return
+        tr.append(entry)
 
     def note_wrap(self):
         if not self.mute:
@@ -513,11 +553,14 @@ def _int32_ok(cell: Tuple[int, int], bits: int) -> bool:
 
 def _check_float_exact(interp, where, ops, result_cells_hull):
     """Shared float-policy check for arithmetic combining floats."""
-    if any(_dkind(o.dtype)[0] == "float" and not o.exactf for o in ops):
+    bad = next((o for o in ops
+                if _dkind(o.dtype)[0] == "float" and not o.exactf), None)
+    if bad is not None:
+        why = f" [{bad.fwhy}]" if bad.fwhy else ""
         interp.ctx.violate(
             "float", where,
             "float operand without exact-integer provenance "
-            "(only int->f32 converts of values |v| <= 2^24 are vetted)",
+            f"(only int->f32 converts of values |v| <= 2^24 are vetted){why}",
         )
         return False
     lo, hi = result_cells_hull
@@ -754,9 +797,10 @@ def _r_order(interp, eqn, ins, where):
     out = _out_aval(eqn)
     name = eqn.primitive.name
     ins = [interp.ctx.observe(o, where, f"{name} operand") for o in ins]
-    if any(_dkind(o.dtype)[0] == "float" for o in ins) and name in ("div",):
+    if any(_dkind(o.dtype)[0] == "float" for o in ins) \
+            and name in ("div", "rem"):
         interp.ctx.violate("float", where,
-                           "float division is never exact-integer")
+                           f"float {name} is never exact-integer")
         return [top(out.shape, out.dtype)]
     if name == "min":
         f = lambda x, y: (min(x[0], y[0]), min(x[1], y[1]))  # noqa: E731
@@ -787,7 +831,13 @@ def _r_order(interp, eqn, ins, where):
                     cands.append(q if (xv >= 0) == (yv > 0) else -q)
             return (min(cands) - 1, max(cands) + 1)
 
-    return [_ewise(interp.ctx, out.shape, out.dtype, ins, f)]
+    res = _ewise(interp.ctx, out.shape, out.dtype, ins, f)
+    if _dkind(out.dtype)[0] == "float":
+        # min/max/clamp/abs/sign SELECT (or negate) one operand value:
+        # exactness is preserved whenever every float operand carries the
+        # certificate, and the result magnitude is within operand hulls.
+        res.exactf = _check_float_exact(interp, where, ins, res.joined())
+    return [res]
 
 
 @_rule("integer_pow")
@@ -857,10 +907,11 @@ def _r_convert(interp, eqn, ins, where):
         return [mk(out.shape, out.dtype, a2.cells, **flags)]
     if skind == "float":
         if not a.exactf:
+            why = f" [{a.fwhy}]" if a.fwhy else ""
             interp.ctx.violate(
                 "float", where,
                 "float->int convert of a non-exact float (value may have "
-                "rounded; only exact-integer floats are vetted)",
+                f"rounded; only exact-integer floats are vetted){why}",
             )
             return [full_range(out.shape, out.dtype)]
         a = interp.ctx.observe(
@@ -1128,13 +1179,19 @@ def _r_iota(interp, eqn, ins, where):
     out = _out_aval(eqn)
     dim = eqn.params["dimension"]
     n = out.shape[dim]
+    # An iota varies only along `dim`: every other axis is uniform, in
+    # particular axis 0 whenever dim != 0. A float iota is exact iff its
+    # largest value fits the f32 exact-integer window.
+    exf = _dkind(out.dtype)[0] == "float" and max(n - 1, 0) <= EXACT_F32
+    uni = dim != 0
     if dim == 0 and n <= ROW_CAP:
         return [mk(out.shape, out.dtype, [[(i, i)] for i in range(n)],
-                   dist0=n > 1)]
+                   dist0=n > 1, exactf=exf)]
     if dim == 1 and len(out.shape) > 1 and n <= ROW_CAP:
-        return [mk(out.shape, out.dtype, [[(i, i) for i in range(n)]])]
+        return [mk(out.shape, out.dtype, [[(i, i) for i in range(n)]],
+                   uni0=uni, exactf=exf)]
     return [mk(out.shape, out.dtype, [[(0, max(n - 1, 0))]],
-               dist0=dim == 0 and n > 1)]
+               dist0=dim == 0 and n > 1, uni0=uni, exactf=exf)]
 
 
 @_rule("reduce_sum", "reduce_max", "reduce_min", "reduce_and", "reduce_or")
@@ -1202,7 +1259,15 @@ def _r_reduce(interp, eqn, ins, where):
         ]
         new_cells = [[(c[0] * mult_no0, c[1] * mult_no0)]
                      for c in red0_cells]
-        return [mk(out.shape, out.dtype, new_cells, exactf=a.exactf)]
+        res = mk(out.shape, out.dtype, new_cells, exactf=a.exactf)
+        if _dkind(out.dtype)[0] == "float":
+            # At most one nonzero along axis 0, so the accumulated
+            # |partial sum| over the remaining mult_no0 untracked terms
+            # is exactly the derived cell bound — the hull IS the sound
+            # sum bound here.
+            res.exactf = _check_float_exact(interp, where, ins,
+                                            res.joined())
+        return [res]
 
     cells = a.cells
     if red0:
@@ -1221,8 +1286,39 @@ def _r_reduce(interp, eqn, ins, where):
         new_cells = [[apply_mult(c) for c in row] for row in cells]
     res = mk(out.shape, out.dtype, new_cells, exactf=False)
     if _dkind(out.dtype)[0] == "float":
-        ok = _check_float_exact(interp, where, ins, res.joined())
-        res.exactf = ok
+        if name == "reduce_sum":
+            # SOUND rule: every partial sum of the reduction, under ANY
+            # association order, is bounded by the ACCUMULATED sum of
+            # per-element magnitude bounds — the result hull is not
+            # enough (signs may cancel in the true sum while a partial
+            # sum leaves the 2^24 window and rounds).
+            def cabs(c):
+                return max(abs(c[0]), abs(c[1]))
+
+            if red0 and red1:
+                accs = [mult * sum(cabs(a.cells[i][j])
+                                   for i in range(a.r0)
+                                   for j in range(a.r1))]
+            elif red0:
+                accs = [mult * sum(cabs(a.cells[i][j])
+                                   for i in range(a.r0))
+                        for j in range(a.r1)]
+            elif red1:
+                accs = [mult * sum(cabs(c) for c in row)
+                        for row in a.cells]
+            else:
+                accs = [mult * cabs(c) for row in a.cells for c in row]
+            acc_max = max(accs) if accs else 0
+            k_terms = 1
+            for ax in axes:
+                k_terms *= a.shape[ax]
+            interp.ctx.eqn_facts["sum_abs_bound"] = _sat(acc_max)
+            interp.ctx.eqn_facts["k_terms"] = k_terms
+            res.exactf = _check_float_exact(interp, where, ins,
+                                            (-acc_max, acc_max))
+        elif name in ("reduce_max", "reduce_min"):
+            # Selection: the result is one of the operand elements.
+            res.exactf = a.exactf
     return [res]
 
 
@@ -1303,10 +1399,15 @@ def _r_dot(interp, eqn, ins, where):
     bh = b.joined()
     ps = (ah[0] * bh[0], ah[0] * bh[1], ah[1] * bh[0], ah[1] * bh[1])
     plo, phi = min(ps), max(ps)
-    # Partial sums are bounded by K * max|product| regardless of order.
+    # Partial sums are bounded by K * max|product| regardless of order:
+    # this is the ACCUMULATED sum bound Sum|products|, not the
+    # per-element bound — the quantity that must stay <= 2^24 for the
+    # f32 contraction to be bit-exact at Precision.HIGHEST.
     bound = K * max(abs(plo), abs(phi))
     exactf = False
     if kind == "float":
+        interp.ctx.eqn_facts["sum_abs_bound"] = _sat(bound)
+        interp.ctx.eqn_facts["k_terms"] = K
         ok = _check_float_exact(interp, where, ins, (-bound, bound))
         prec = eqn.params.get("precision")
         prec_ok = False
@@ -1653,6 +1754,36 @@ def _r_custom(interp, eqn, ins, where):
 
 ALLOWED_PRIMITIVES = frozenset(RULES)
 
+# Primitives whose transfer rules implement the exact-float policy: they
+# either preserve the exactness certificate soundly (structural moves,
+# selections, the checked add/mul/dot/reduce arithmetic) or decide the
+# float question themselves (div/rem always violate). Any float32 value
+# produced by a primitive OUTSIDE this set is demoted to inexact by the
+# interpreter post-pass with a sourced diagnostic — an unvetted op can
+# round, so the certificate cannot survive it. A deliberately mutable
+# set (unlike ALLOWED_PRIMITIVES): analysis/pallas_check.py extends it
+# with the Ref primitives whose rules thread exactf through VMEM.
+FLOAT_VETTED = {
+    # checked arithmetic (each rule proves bound <= 2^24 or violates)
+    "add", "sub", "mul", "neg", "dot_general",
+    "reduce_sum", "reduce_max", "reduce_min",
+    # selections / comparisons (result is one of the operand values)
+    "min", "max", "clamp", "abs", "sign", "select_n",
+    # rules that always violate on float themselves
+    "div", "rem",
+    # converts (rule checks the 2^24 window / certificate)
+    "convert_element_type",
+    # structural moves: values are copied, never recomputed
+    "broadcast_in_dim", "reshape", "squeeze", "transpose", "slice",
+    "concatenate", "pad", "rev", "gather", "dynamic_slice",
+    "dynamic_update_slice", "scatter", "iota",
+    "device_put", "copy", "stop_gradient",
+    # control flow: certificates propagate through the recursive walk
+    "scan", "while", "cond", "pjit", "closed_call", "core_call",
+    "remat", "checkpoint", "custom_jvp_call", "custom_vjp_call",
+    "custom_jvp_call_jaxpr",
+}
+
 
 # ---------------------------------------------------------------------------
 # The interpreter.
@@ -1698,6 +1829,7 @@ class _Interp:
                         "(TPU lowers 64-bit integer ops as pairs; banned)",
                     )
             ins = [self._read(env, v) for v in eqn.invars]
+            self.ctx.eqn_facts = {}
             rule = RULES.get(name)
             if rule is None:
                 self.ctx.violate(
@@ -1720,10 +1852,57 @@ class _Interp:
                     outs = [top(v.aval.shape, v.aval.dtype)
                             for v in eqn.outvars]
             _poly_transfer(eqn, ins, outs)
+            self._float_post(name, ew, ins, outs)
             for var, o in zip(eqn.outvars, outs, strict=True):
                 if type(var).__name__ != "DropVar":
                     env[var] = o
         return [self._read(env, v) for v in jaxpr.outvars]
+
+    def _float_post(self, name, ew, ins, outs):
+        """Exact-float post-pass, run on EVERY equation: demote float
+        outputs of primitives without a vetted exact-float transfer
+        (they may round), attach demotion provenance, and append the
+        per-value entry to the exactness trace."""
+        ctx = self.ctx
+        facts = ctx.eqn_facts
+        for oi, o in enumerate(outs):
+            if _dkind(o.dtype)[0] != "float":
+                continue
+            if name not in FLOAT_VETTED:
+                o.exactf = False
+                o.fwhy = (f"certificate demoted at {ew}: `{name}` has no "
+                          "vetted exact-float transfer")
+                ctx.violate(
+                    "float", ew,
+                    f"float32 value produced by `{name}`, which is not on "
+                    "the exact-float vetted list: the value may have "
+                    "rounded, so the exactness certificate is demoted "
+                    "to inexact here",
+                )
+            if o.exactf:
+                o.fwhy = None
+            elif o.fwhy is None:
+                # Inherit the demotion source from the first inexact
+                # float operand; otherwise this equation is the source.
+                o.fwhy = next(
+                    (i.fwhy for i in ins
+                     if _dkind(i.dtype)[0] == "float" and i.fwhy),
+                    f"certificate demoted at {ew}")
+            if not ctx.mute:
+                lo, hi = o.joined()
+                m = max(abs(lo), abs(hi))
+                entry = {
+                    "where": ew, "prim": name, "out": oi,
+                    "dtype": o.dtype.name, "exact": bool(o.exactf),
+                    "bound": "unbounded" if m >= INF else int(m),
+                }
+                for k, v in facts.items():
+                    entry[k] = ("unbounded"
+                                if isinstance(v, int) and abs(v) >= INF
+                                else v)
+                if not o.exactf:
+                    entry["reason"] = o.fwhy
+                ctx.trace_float(entry)
 
 
 # ---------------------------------------------------------------------------
@@ -1763,6 +1942,13 @@ def analyze_closed(closed, name: str, in_bounds=None,
         return report
     for i, o in enumerate(outs):
         o2 = ctx.observe(o, f"{name}/out{i}", "kernel output")
+        if _dkind(o.dtype)[0] == "float" and not o.exactf:
+            why = f" [{o.fwhy}]" if o.fwhy else ""
+            ctx.violate(
+                "float", f"{name}/out{i}",
+                "unproven f32 value reaches a consensus-visible "
+                f"output{why}",
+            )
         report.out_bounds.append(o.rows0() if o.shape else [o.joined()])
         if out_within is not None and i < len(out_within) \
                 and out_within[i] is not None:

@@ -51,9 +51,17 @@ epilogue end to end. (`interval.ALLOWED_PRIMITIVES` is a frozen
 import-time snapshot and intentionally does not grow: state primitives
 are only legal inside a Pallas trace, where these rules vet them.)
 
+The exact-float certificate of `interval.py` carries through unchanged:
+ref reads/writes preserve `exactf`/`fwhy`, an inexact f32 value written
+into VMEM is a gate failure at the write site, and the state primitives
+are registered on `interval.FLOAT_VETTED` so the post-pass does not
+demote values they merely move.
+
 `NEGATIVES` holds deliberately broken toy kernels (out-of-bounds index
 map, read-before-write scratch, an overflowing fe_mul-without-canon
-chain, a double-written output block) used by the tests and
+chain, a double-written output block, plus three unsound f32 chains: a
+default-precision dot, a 2^24-overflowing accumulation, and a float
+round-trip through an unvetted op) used by the tests and
 `scripts/consensus_lint.py --negative` to prove the gate actually fires.
 """
 
@@ -219,6 +227,14 @@ class RefAbstract:
     # -- write --------------------------------------------------------------
 
     def write(self, ctx, idx, val, where, weak):
+        if self.dtype.kind == "f" and not val.exactf:
+            why = f" [{val.fwhy}]" if getattr(val, "fwhy", None) else ""
+            ctx.violate(
+                "float", where,
+                f"inexact float32 value written to {self.kind} ref "
+                f"`{self.name}`: every VMEM-resident f32 table must carry "
+                f"an exact-integer certificate{why}",
+            )
         rows, keeps, trailing_full, exact = self._resolve(ctx, idx, where)
         slots = sorted({self._slot(r) for r in rows})
         full_slice = (keeps and self.gran == self.n0 and trailing_full
@@ -733,6 +749,13 @@ IV.RULES["addupdate"] = _r_addupdate
 IV.RULES["program_id"] = _r_program_id
 IV.RULES["pallas_call"] = _r_pallas_call
 
+# The state primitives move values without float arithmetic (get/swap
+# return the refs' own certificates; addupdate and pallas_call results
+# are re-checked at the ref layer above), so they preserve the carried
+# exact-float certificate rather than demoting it.
+IV.FLOAT_VETTED.update({"get", "swap", "addupdate", "pallas_call",
+                        "program_id"})
+
 
 # ---------------------------------------------------------------------------
 # Toy kernels: the gate must demonstrably fire. Each builder returns
@@ -846,11 +869,86 @@ def _build_double_write():
     return fn, args, {0: (0, 100)}
 
 
+def _build_f32_default_precision_dot():
+    from jax.experimental import pallas as pl
+
+    def kern(x_ref, o_ref):
+        xf = x_ref[:].astype(jnp.float32)
+        # Missing precision=HIGHEST: the MXU's default f32 path goes
+        # through bfloat16 passes, so the products may round.
+        y = jax.lax.dot_general(xf, xf, (((1,), (1,)), ((), ())))
+        o_ref[:] = jnp.broadcast_to(y.astype(jnp.int32)[:, :1],
+                                    (8, _TOY_TILE))
+
+    def fn(x):
+        return pl.pallas_call(
+            kern, grid=(2,),
+            in_specs=[_toy_specs(8, _TOY_TILE)],
+            out_specs=_toy_specs(8, _TOY_TILE),
+            out_shape=jax.ShapeDtypeStruct((8, 2 * _TOY_TILE), jnp.int32),
+        )(x)
+
+    args = (jax.ShapeDtypeStruct((8, 2 * _TOY_TILE), jnp.int32),)
+    # Sigma|products| = 128 * 100^2 well below 2^24: the ONLY defect is
+    # the missing precision keyword.
+    return fn, args, {0: (0, 100)}
+
+
+def _build_f32_accum_overflow():
+    from jax.experimental import pallas as pl
+
+    def kern(x_ref, o_ref):
+        xf = x_ref[:].astype(jnp.float32)
+        # HIGHEST precision, every product exact (512^2 = 2^18), but the
+        # accumulated sum 128 * 2^18 = 2^25 exceeds the f32 mantissa.
+        y = jax.lax.dot_general(xf, xf, (((1,), (1,)), ((), ())),
+                                precision=jax.lax.Precision.HIGHEST)
+        o_ref[:] = jnp.broadcast_to(y.astype(jnp.int32)[:, :1],
+                                    (8, _TOY_TILE))
+
+    def fn(x):
+        return pl.pallas_call(
+            kern, grid=(2,),
+            in_specs=[_toy_specs(8, _TOY_TILE)],
+            out_specs=_toy_specs(8, _TOY_TILE),
+            out_shape=jax.ShapeDtypeStruct((8, 2 * _TOY_TILE), jnp.int32),
+        )(x)
+
+    args = (jax.ShapeDtypeStruct((8, 2 * _TOY_TILE), jnp.int32),)
+    return fn, args, {0: (0, 1 << 9)}
+
+
+def _build_f32_unvetted_roundtrip():
+    from jax.experimental import pallas as pl
+
+    def kern(x_ref, o_ref):
+        xf = x_ref[:].astype(jnp.float32)
+        # integer_pow is on the determinism allowlist but has no vetted
+        # exact-float transfer: the certificate must demote here and the
+        # astype(int32) round-trip must fail with a sourced diagnostic.
+        y = xf ** 2
+        o_ref[:] = y.astype(jnp.int32)
+
+    def fn(x):
+        return pl.pallas_call(
+            kern, grid=(2,),
+            in_specs=[_toy_specs(8, _TOY_TILE)],
+            out_specs=_toy_specs(8, _TOY_TILE),
+            out_shape=jax.ShapeDtypeStruct((8, 2 * _TOY_TILE), jnp.int32),
+        )(x)
+
+    args = (jax.ShapeDtypeStruct((8, 2 * _TOY_TILE), jnp.int32),)
+    return fn, args, {0: (0, 100)}
+
+
 NEGATIVES = {
     "oob-index-map": _build_oob_index_map,
     "read-before-write": _build_read_before_write,
     "mul-overflow-no-canon": _build_mul_overflow,
     "double-write": _build_double_write,
+    "f32-default-precision-dot": _build_f32_default_precision_dot,
+    "f32-accum-overflow": _build_f32_accum_overflow,
+    "f32-unvetted-roundtrip": _build_f32_unvetted_roundtrip,
 }
 
 

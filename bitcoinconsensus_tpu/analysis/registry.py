@@ -109,6 +109,14 @@ def _specs() -> List[KernelSpec]:
             in_bounds={0: w2}, out_within=[w2],
         ),
         KernelSpec(
+            "mxu.fe_mul_onehot",
+            lambda B: (_mxu_mul_fn(), (_fe(B), _fe(B))),
+            in_bounds={0: w2, 1: w2}, out_within=[w2],
+            note="MXU one-hot fe_mul candidate: every f32 value carries "
+                 "an exactness certificate (accumulated Sigma|products| "
+                 "<= 2^24 at Precision.HIGHEST); see ops/mxu_mul.py",
+        ),
+        KernelSpec(
             "limbs.fe_canon", lambda B: (L.fe_canon, (_fe(B),)),
             in_bounds={0: w2}, out_within=[canon],
         ),
@@ -202,6 +210,11 @@ def _specs() -> List[KernelSpec]:
         ),
     ]
     return specs
+
+
+def _mxu_mul_fn():
+    from ..ops import mxu_mul as M
+    return M.fe_mul_onehot
 
 
 def _verify_kernel_fn():

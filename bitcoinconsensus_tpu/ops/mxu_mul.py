@@ -1,0 +1,117 @@
+"""MXU one-hot fe_mul candidate: the limb convolution as f32 dot_generals.
+
+The ROADMAP's kernel arc moves `fe_mul` off the VPU by phrasing the
+radix-2^13 schoolbook convolution as systolic-array work: limb digits
+ride through `dot_general` at `Precision.HIGHEST` against a one-hot
+selector, so the MXU does the column gather-and-accumulate that today
+costs 39 shifted adds per multiply. This module is the *reference-shaped
+candidate* for that lowering — bit-identical to `limbs.fe_mul` (the
+tests diff them across >= 10k seeded operand pairs) and, more
+importantly, **provably** bit-identical: `analysis/interval.py`'s
+carried exact-float domain certifies every f32 value in here
+integer-valued with an accumulated magnitude bound
+Sigma|products| <= 2^24, and `scripts/consensus_lint.py --exactness`
+emits the per-value theorem trace. Registered as
+`mxu.fe_mul_onehot` in `analysis/registry.py`.
+
+Shape of the proof (all bounds static, derived independently by the
+analyzer — a mismatch in either direction is a finding):
+
+- Weak limbs are <= max(W2) = 15631 < 2^14, too wide for an exact f32
+  product chain, so each operand splits into 7-bit digits
+  `a = a0 + 2^7 * a1` with `a0 <= 127` and `a1 <= 122`.
+- One digit convolution runs as two HIGHEST-precision dots against the
+  traced one-hot selector S3[j, k, i] = [i + j == k] (built from
+  `broadcasted_iota` equality, so the analyzer *derives* its
+  one-hot-along-axis-0 structure instead of trusting a constant):
+  U[b, k, i] = sum_j y[j, b] * S3[j, k, i] = y[k - i, b], then
+  V[b, k] = sum_i U[b, k, i] * x[i, b] = sum_{i+j=k} x[i,b] * y[j,b].
+  The accumulated sum bound is NLIMB * 127 * 127 = 322,580 <= 2^24,
+  so every partial sum is an exactly-representable f32 integer.
+- The four digit convolutions recombine in int32
+  (2^14 = 2 * 2^13 moves the high-high term one column up), every
+  column staying < 2^31, and `_settle` drives the 40 columns into the
+  same W2 weak form `limbs.fe_mul` produces.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+from .limbs import NLIMB, W2, _pad_rows, _settle
+
+_NCOL = 2 * NLIMB - 1  # schoolbook columns of an NLIMB x NLIMB product
+
+# 7-bit digit split of the <= 14-bit weak limbs.
+_DIGIT_BITS = 7
+_D0 = (1 << _DIGIT_BITS) - 1   # low-digit bound  (a & 127)
+_D1 = max(W2) >> _DIGIT_BITS   # high-digit bound (15631 >> 7 = 122)
+
+# Accumulated-sum bounds of the four digit convolutions: at most NLIMB
+# products land in one column. Each must sit inside the 2^24 f32
+# exact-integer window — these are the theorem obligations the analyzer
+# re-derives per value.
+_B00 = NLIMB * _D0 * _D0       # 322,580
+_B01 = NLIMB * _D0 * _D1       # 309,880 (t01 and t10 alike)
+_B11 = NLIMB * _D1 * _D1       # 297,680
+assert max(_B00, _B01, _B11) <= 1 << 24
+
+# Recombination bounds (int32): col = t00 + (t01 + t10) * 2^7, and the
+# high-high term shifts one column up via 2^14 = 2 * 2^13.
+_COLB = _B00 + 2 * _B01 * (1 << _DIGIT_BITS)
+_COL40_BOUNDS = [_COLB] + [_COLB + 2 * _B11] * (_NCOL - 1) + [2 * _B11]
+for _b in _COL40_BOUNDS:
+    assert _b < 2 ** 31, _b
+
+
+def _onehot_selector():
+    """S3[j, k, i] = 1.0 iff i + j == k, traced from iota equality.
+
+    Building it in-graph (rather than a captured numpy constant) lets
+    the interval analyzer derive nz0-along-axis-0 — at most one j hits
+    any (k, i) cell — which is what makes the first dot a pure gather
+    with contraction multiplicity 1.
+    """
+    shape = (NLIMB, _NCOL, NLIMB)
+    jj = lax.broadcasted_iota(jnp.int32, shape, 0)
+    kk = lax.broadcasted_iota(jnp.int32, shape, 1)
+    ii = lax.broadcasted_iota(jnp.int32, shape, 2)
+    return (jj == (kk - ii)).astype(jnp.float32)
+
+
+def _conv_mxu(x, y):
+    """One digit convolution: (NLIMB, B) x (NLIMB, B) -> (2*NLIMB-1, B).
+
+    out[k, b] = sum_{i+j=k} x[i, b] * y[j, b], computed as two
+    HIGHEST-precision f32 dots (gather via the one-hot selector, then
+    the per-lane contraction on the MXU).
+    """
+    s3 = _onehot_selector()
+    xf = x.astype(jnp.float32)
+    yf = y.astype(jnp.float32)
+    # U[b, k, i] = y[k - i, b] (zero outside the band): one-hot gather.
+    u = lax.dot_general(yf, s3, (((0,), (0,)), ((), ())),
+                        precision=lax.Precision.HIGHEST)
+    # V[b, k] = sum_i U[b, k, i] * x[i, b]: the column accumulation.
+    v = lax.dot_general(u, xf, (((2,), (0,)), ((0,), (1,))),
+                        precision=lax.Precision.HIGHEST)
+    return v.astype(jnp.int32).T
+
+
+def fe_mul_onehot(a, b):
+    """a * b mod p via one-hot f32 MXU dots (weak in, weak out).
+
+    Bit-identical to `limbs.fe_mul` after `fe_canon` (the two produce
+    different — equally valid — weak representatives of the same
+    residue; canonical form is where consensus identity is defined).
+    """
+    a0, a1 = a & _D0, a >> _DIGIT_BITS
+    b0, b1 = b & _D0, b >> _DIGIT_BITS
+    t00 = _conv_mxu(a0, b0)
+    t01 = _conv_mxu(a0, b1)
+    t10 = _conv_mxu(a1, b0)
+    t11 = _conv_mxu(a1, b1)
+    col = t00 + (t01 + t10) * (1 << _DIGIT_BITS)
+    col40 = _pad_rows(col, 0, 1) + _pad_rows(2 * t11, 1, 0)
+    return _settle(col40, list(_COL40_BOUNDS))

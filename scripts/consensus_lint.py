@@ -8,17 +8,28 @@ deterministic, and lint the host-side consensus path.
     python scripts/consensus_lint.py --kernel pallas.verify_tiles
     python scripts/consensus_lint.py --report out.json
     python scripts/consensus_lint.py --negative oob-index-map
+    python scripts/consensus_lint.py --exactness --report theorems.json
 
 Exit status 0 iff every kernel proves clean AND the host lint is clean.
 The JSON report carries the derived per-limb output bounds of every
-kernel — plus, for Pallas kernels, the peak VMEM live set and grid —
-so reviewers can diff bounds across PRs (CI uploads it as a build
+kernel — plus, for Pallas kernels, the peak VMEM live set and grid, and
+for kernels with f32 values, the per-value exactness trace — so
+reviewers can diff bounds across PRs (CI uploads it as a build
 artifact).
 
 `--negative NAME` runs one of the deliberately broken toy Pallas
 kernels from `analysis/pallas_check.NEGATIVES` and exits non-zero with
 its diagnostics: the gate proving it still fires. `--negative list`
 lists the available toys.
+
+`--exactness` is the exact-float theorem leg: for each f32-bearing
+kernel (default: the MXU one-hot fe_mul candidate and the two existing
+one-hot select chains) it re-proves the kernel and emits the
+machine-checkable per-value bound trace — every float32 value
+integer-valued with magnitude (and accumulated dot/reduce sums)
+<= 2^24 — then requires every `f32-*` negative toy to be REJECTED with
+a `float` violation. Exit 0 iff all theorems hold and all unsound toys
+are rejected; `--report` writes the theorem sections as JSON.
 """
 
 from __future__ import annotations
@@ -48,6 +59,10 @@ def main() -> int:
     ap.add_argument("--negative", default=None, metavar="NAME",
                     help="run one broken toy Pallas kernel (or `list`); "
                          "exits non-zero with its diagnostics")
+    ap.add_argument("--exactness", action="store_true",
+                    help="exact-float theorem leg: prove every f32 value "
+                         "in the one-hot MXU kernels integer-exact and "
+                         "reject all f32-* negative toys")
     args = ap.parse_args()
 
     from bitcoinconsensus_tpu.analysis import host_lint, registry
@@ -65,6 +80,9 @@ def main() -> int:
             print(f"  {v.kind:10s} {v.where}")
             print(f"             {v.msg}")
         return 1 if not rep.ok else 0
+
+    if args.exactness:
+        return _exactness_leg(args, registry)
 
     specs = registry.all_kernels(include_heavy=not args.quick)
     if args.kernel:
@@ -126,6 +144,75 @@ def main() -> int:
         print(f"\nreport written to {args.report}")
 
     print(f"\nconsensus lint: {'OK' if all_ok else 'FAILED'}")
+    return 0 if all_ok else 1
+
+
+# The f32-bearing consensus kernels: the MXU one-hot fe_mul candidate
+# and the two existing one-hot select chains (ops/curve.py GLV G-table,
+# ops/pallas_kernel.py VMEM G-table). Every f32 chain a consensus
+# verdict can see must be listed here once it exists.
+EXACTNESS_KERNELS = [
+    "mxu.fe_mul_onehot",
+    "curve.double_scalar_mult_glv",
+    "pallas.verify_tiles",
+]
+
+
+def _exactness_leg(args, registry) -> int:
+    from bitcoinconsensus_tpu.analysis import pallas_check
+
+    names = args.kernel or EXACTNESS_KERNELS
+    sections = []
+    all_ok = True
+
+    print("== exact-float theorems (carried f32 exactness prover) ==")
+    for name in names:
+        spec = registry.get_kernel(name)
+        t0 = time.time()
+        try:
+            rep = spec.analyze()
+        except Exception as e:  # trace failure is a gate failure
+            print(f"  {name:40s} ERROR: {type(e).__name__}: {e}")
+            sections.append({"name": name, "ok": False,
+                             "error": f"{type(e).__name__}: {e}"})
+            all_ok = False
+            continue
+        dt = time.time() - t0
+        f32 = [e for e in rep.exactness
+               if str(e.get("dtype", "")).startswith("float")]
+        bounds = [e["bound"] for e in f32
+                  if isinstance(e.get("bound"), int)]
+        status = ("THEOREM" if rep.ok and f32 else
+                  "VACUOUS" if rep.ok else "FAIL")
+        print(f"  {name:40s} {status}  f32_values={len(f32)}"
+              f" max_bound={max(bounds) if bounds else 0}  ({dt:.1f}s)")
+        for v in rep.violations[:8]:
+            print(f"      {v.kind:10s} {v.where}")
+            print(f"                 {v.msg}")
+        sections.append({"name": name, "ok": rep.ok, "theorem": status,
+                         "f32_values": len(f32),
+                         "max_bound": max(bounds) if bounds else 0,
+                         "trace": rep.exactness})
+        all_ok = all_ok and rep.ok
+
+    print("\n== unsound f32 toys must be rejected ==")
+    for name in sorted(n for n in pallas_check.NEGATIVES
+                       if n.startswith("f32-")):
+        rep = pallas_check.analyze_negative(name)
+        rejected = (not rep.ok
+                    and any(v.kind == "float" for v in rep.violations))
+        verdict = ("REJECTED (expected)" if rejected
+                   else "NOT REJECTED (gate is dead!)")
+        print(f"  {name:40s} {verdict}")
+        sections.append({"name": f"negative.{name}", "rejected": rejected})
+        all_ok = all_ok and rejected
+
+    if args.report:
+        with open(args.report, "w") as fh:
+            json.dump({"exactness": sections}, fh, indent=2, sort_keys=True)
+        print(f"\nreport written to {args.report}")
+
+    print(f"\nexactness theorems: {'OK' if all_ok else 'FAILED'}")
     return 0 if all_ok else 1
 
 

@@ -150,6 +150,97 @@ def test_understating_hand_bound_fails_loudly():
 
 
 # ---------------------------------------------------------------------------
+# Exact-float certificate: soundness edges of the carried domain.
+
+
+def test_unvetted_prim_demotes_certificate_with_source():
+    # integer_pow is on the determinism allowlist but has no vetted
+    # exact-float transfer: the certificate demotes there, and the
+    # downstream astype(int32) cites the demotion site.
+    def bad(x):
+        return (x.astype(jnp.float32) ** 2).astype(jnp.int32)
+
+    rep = IV.analyze(bad, (_fe(),), "bad.unvetted", in_bounds={0: (0, 100)})
+    assert not rep.ok
+    assert "float" in _kinds(rep)
+    demote = next(v for v in rep.violations if "integer_pow" in v.msg)
+    assert "vetted" in demote.msg
+    conv = next(v for v in rep.violations if "float->int" in v.msg)
+    assert "integer_pow" in conv.msg  # sourced via the carried fwhy
+
+
+def test_dot_accumulation_boundary():
+    # The sound dot rule is the ACCUMULATED sum bound: K * max|product|
+    # <= 2^24. K = 16, |x| <= 1024 sits exactly at 16 * 1024^2 = 2^24
+    # (every partial sum representable); one past the operand bound
+    # overflows the mantissa and must fail.
+    def dotk(x):
+        xf = x.astype(jnp.float32)
+        y = lax.dot_general(xf, xf, (((0,), (0,)), ((), ())),
+                            precision=lax.Precision.HIGHEST)
+        return y.astype(jnp.int32)
+
+    shape = jax.ShapeDtypeStruct((16, B), jnp.int32)
+    rep = IV.analyze(dotk, (shape,), "dot.at_bound", in_bounds={0: (0, 1024)})
+    assert rep.ok, rep.violations[:3]
+    entry = next(e for e in rep.exactness if e["prim"] == "dot_general")
+    assert entry["exact"] and entry["k_terms"] == 16
+    assert entry["sum_abs_bound"] == 1 << 24
+
+    rep = IV.analyze(dotk, (shape,), "dot.past_bound",
+                     in_bounds={0: (0, 1025)})
+    assert not rep.ok
+    assert "float" in _kinds(rep)
+
+
+def test_reduce_sum_cancellation_is_caught():
+    # Witness for why the result-hull check was unsound: rows pinned to
+    # +/-(2^24 - 1) sum to the exact hull [0, 0], but a partial sum
+    # reaches 2 * (2^24 - 1) > 2^24 — only the accumulated Sigma|terms|
+    # bound is sound.
+    m = (1 << 24) - 1
+    rows = [(m, m), (-m, -m), (m, m), (-m, -m)]
+
+    def bad(x):
+        return x.astype(jnp.float32).sum(axis=0).astype(jnp.int32)
+
+    shape = jax.ShapeDtypeStruct((4, B), jnp.int32)
+    rep = IV.analyze(bad, (shape,), "bad.cancel", in_bounds={0: rows})
+    assert not rep.ok
+    assert "float" in _kinds(rep)
+
+
+def test_astype_roundtrip_recovers_certificate():
+    # int->f32 re-grants the certificate regardless of history: the
+    # round-tripped chain proves clean and every f32 value in the trace
+    # is certified exact.
+    def fn(x):
+        y = (x.astype(jnp.float32) + 1.0).astype(jnp.int32)
+        return (y * 1000).astype(jnp.float32).astype(jnp.int32)
+
+    rep = IV.analyze(fn, (_fe(),), "roundtrip", in_bounds={0: (0, 100)})
+    assert rep.ok, rep.violations[:3]
+    f32 = [e for e in rep.exactness if e["dtype"] == "float32"]
+    assert f32 and all(e["exact"] for e in f32)
+    assert rep.to_dict()["exactness"] == rep.exactness
+
+
+def test_unproven_f32_output_is_flagged_at_the_gate():
+    def bad(x):
+        return x.astype(jnp.float32) * 0.5
+
+    rep = IV.analyze(bad, (_fe(),), "bad.f32out", in_bounds={0: (0, 100)})
+    assert not rep.ok
+    assert any("consensus-visible output" in v.msg for v in rep.violations)
+
+
+def test_exact_f32_output_passes_the_gate():
+    rep = IV.analyze(lambda x: x.astype(jnp.float32), (_fe(),),
+                     "ok.f32out", in_bounds={0: (0, 100)})
+    assert rep.ok, rep.violations[:3]
+
+
+# ---------------------------------------------------------------------------
 # Host-side AST lint.
 
 
@@ -204,6 +295,23 @@ def test_host_lint_sync_rule_flags_hidden_blocking(tmp_path):
     assert [f.rule for f in findings] == ["sync"] * 3
     assert [f.line for f in findings] == [2, 3, 4]
     assert all("settle" in f.msg for f in findings)
+
+
+def test_host_lint_flags_unpinned_dot_precision(tmp_path):
+    p = tmp_path / "bad_dot.py"
+    p.write_text(
+        "import jax.numpy as jnp\n"
+        "from jax import lax\n"
+        "y = jnp.dot(a, b)\n"
+        "z = lax.dot_general(a, b, dn, precision=lax.Precision.DEFAULT)\n"
+        "ok = jax.lax.dot_general(a, b, dn,\n"
+        "                         precision=lax.Precision.HIGHEST)\n"
+    )
+    findings = host_lint.lint_paths([str(p)],
+                                    rules=host_lint.PRECISION_RULES)
+    assert [f.rule for f in findings] == ["dot-precision"] * 2
+    assert [f.line for f in findings] == [3, 4]
+    assert all("HIGHEST" in f.msg for f in findings)
 
 
 def test_host_lint_clean_on_consensus_path():

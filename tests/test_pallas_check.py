@@ -74,6 +74,56 @@ def test_double_written_output_block_is_flagged():
     assert any("never written" in m for m in msgs)
 
 
+def test_f32_default_precision_dot_is_flagged():
+    rep = pallas_check.analyze_negative("f32-default-precision-dot")
+    assert not rep.ok
+    assert "float" in _kinds(rep)
+    v = next(v for v in rep.violations if v.kind == "float")
+    assert "Precision.HIGHEST" in v.msg
+    assert "/kernel" in v.where  # proven inside the Pallas body
+
+
+def test_f32_accum_overflow_is_flagged():
+    # every product exact, HIGHEST precision — only the accumulated
+    # Sigma|products| bound catches the 2^25 sum.
+    rep = pallas_check.analyze_negative("f32-accum-overflow")
+    assert not rep.ok
+    msgs = [v.msg for v in rep.violations if v.kind == "float"]
+    assert any("2^24" in m for m in msgs)
+
+
+def test_f32_unvetted_roundtrip_demotes_with_source():
+    rep = pallas_check.analyze_negative("f32-unvetted-roundtrip")
+    assert not rep.ok
+    msgs = [v.msg for v in rep.violations if v.kind == "float"]
+    assert any("integer_pow" in m and "vetted" in m for m in msgs)
+    # the downstream astype(int32) cites the demotion site
+    assert any("float->int" in m and "integer_pow" in m for m in msgs)
+
+
+def test_inexact_f32_vmem_write_is_flagged():
+    import jax
+    from jax.experimental import pallas as pl
+
+    def kern(x_ref, o_ref):
+        o_ref[:] = x_ref[:].astype(jnp.float32) ** 2
+
+    def fn(x):
+        return pl.pallas_call(
+            kern, grid=(2,),
+            in_specs=[pl.BlockSpec((8, 128), lambda i: (0, i))],
+            out_specs=pl.BlockSpec((8, 128), lambda i: (0, i)),
+            out_shape=jax.ShapeDtypeStruct((8, 256), jnp.float32),
+        )(x)
+
+    rep = pallas_check.IV.analyze(
+        fn, (jax.ShapeDtypeStruct((8, 256), jnp.int32),),
+        "pallas.f32write", in_bounds={0: (0, 100)})
+    assert not rep.ok
+    assert any(v.kind == "float" and "written to out ref" in v.msg
+               for v in rep.violations)
+
+
 def test_every_negative_fails():
     # the registry consensus_lint --negative relies on: no toy may rot
     # into proving clean.
