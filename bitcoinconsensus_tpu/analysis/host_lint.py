@@ -313,3 +313,68 @@ def lint_consensus_host(repo_root: str) -> List[LintFinding]:
          os.path.join(pkg, "models", "sigstore.py")],
         rules=SYNC_RULES)
     return findings
+
+
+# -- kernel region-annotation coverage (PR 17) ---------------------------
+#
+# Not an AST rule: this one traces. Every kernel registered in
+# `analysis/registry` must execute under a `region:` named scope
+# (`ops/regions.py`) so the xprof observatory can attribute its device
+# time — a kernel landing without annotation would silently grow the
+# `unattributed` share of every capture. Kept in this module because it
+# is a lint (finding-shaped, wired into `scripts/consensus_lint.py`),
+# with lazy imports so the pure-AST rules above stay dependency-free.
+
+# A kernel passes when at least this fraction of its element ops sit
+# under some region scope. Below 1.0 because trace plumbing (argument
+# converts, output reshapes) legitimately sits outside the scopes.
+REGION_MIN_COVERAGE = 0.90
+
+
+def region_coverage(fn, args) -> float:
+    """Fraction of a traced callable's element ops under region scopes."""
+    import jax
+
+    from ..obs import xprof
+
+    closed = jax.make_jaxpr(fn)(*args)
+    acc = xprof.walk_jaxpr_regions(closed.jaxpr)
+    total = sum(b["ops"] for b in acc.values())
+    if total <= 0:
+        return 0.0
+    named = sum(b["ops"] for stack, b in acc.items() if stack)
+    return named / total
+
+
+def lint_kernel_regions(
+    include_heavy: bool = False,
+    min_coverage: float = REGION_MIN_COVERAGE,
+    specs=None,
+) -> List[LintFinding]:
+    """One finding per registry kernel not covered by named regions.
+
+    `specs` overrides the registry list (the negative-fixture tests feed
+    a deliberately unannotated toy through the same gate).
+    """
+    from . import registry
+
+    if specs is None:
+        specs = registry.all_kernels(include_heavy=include_heavy)
+    findings: List[LintFinding] = []
+    for spec in specs:
+        try:
+            fn, args = spec.build(registry.DEFAULT_BATCH)
+            cov = region_coverage(fn, args)
+        except Exception as e:  # an untraceable kernel is a finding too
+            findings.append(LintFinding(
+                spec.name, 0, "region",
+                f"region-coverage trace failed: {type(e).__name__}: {e}"))
+            continue
+        if cov < min_coverage:
+            findings.append(LintFinding(
+                spec.name, 0, "region",
+                f"only {cov:.0%} of element ops run under a region: "
+                f"scope (< {min_coverage:.0%}) — annotate the kernel "
+                f"with ops/regions.named_region so xprof can attribute "
+                f"its device time"))
+    return findings

@@ -74,6 +74,7 @@ from ..ops.curve import (
     double_scalar_mult_glv,
     jacobian_to_affine,
 )
+from ..ops.regions import named_region, region_scope
 from .glv import split_lambda
 from .secp_host import N, parse_der_lax
 from ..resilience import degrade as _degrade
@@ -317,56 +318,65 @@ def _verify_kernel(fields, want_odd, parity_req, has_t2, neg1, neg2, valid):
     sharing field 1, signs in neg1/neg2). Unpacks to limb-major (20, B),
     lifts P's y from (px, want_odd) via fe_sqrt, runs
     R = a·G + (±b1 ± lambda·b2)·P with the GLV schedule, and accepts per
-    lane: R.x == t1, or (has_t2) R.x == t1 + n, with optional R.y parity."""
-    a = bytes_to_limbs(fields[:, 0])
-    b1 = bytes_to_limbs(fields[:, 1, :16], nlimb=10)
-    b2 = bytes_to_limbs(fields[:, 1, 16:], nlimb=10)
-    px = bytes_to_limbs(fields[:, 2])
-    t1 = bytes_to_limbs(fields[:, 3])
+    lane: R.x == t1, or (has_t2) R.x == t1 + n, with optional R.y parity.
 
-    seven = jnp.broadcast_to(
-        jnp.asarray(_SEVEN_LIMBS).reshape(NLIMB, 1), px.shape
-    ).astype(px.dtype)
-    rhs = fe_add(fe_mul(fe_sqr(px), px), seven)  # x^3 + 7
-    ycand = fe_canon(fe_sqrt(rhs))
-    sq_ok = fe_is_zero(fe_sub(fe_mul(ycand, ycand), rhs))
-    odd = (ycand[0] & 1) == 1
-    yneg = fe_sub(jnp.zeros_like(ycand), ycand)  # weak rep is fine here
-    flip = odd != (want_odd == 1)
-    py = jnp.where(flip[None], yneg, ycand)
-    valid = valid & sq_ok
-    # Sanitize: invalid lanes (non-residue x — off-curve garbage) are
-    # replaced by the generator so EVERY lane runs on-curve group math.
-    # This keeps the explicitly-tracked infinity masks sound (off-curve
-    # orbits obey no group law and could hit Z ≡ 0 unflagged, which
-    # would zero the cross-lane batch-inversion product); the verdicts
-    # of these lanes are masked by `valid` regardless.
-    gxb = jnp.broadcast_to(
-        jnp.asarray(_GX_LIMBS).reshape(NLIMB, 1), px.shape
-    ).astype(px.dtype)
-    gyb = jnp.broadcast_to(
-        jnp.asarray(_GY_LIMBS).reshape(NLIMB, 1), px.shape
-    ).astype(px.dtype)
-    px = jnp.where(valid[None], px, gxb)
-    py = jnp.where(valid[None], py, gyb)
+    Region scopes (`ops/regions.py`) split the program for device-time
+    attribution: point_decode (unpack + y-lift + sanitize), scalar_mult
+    (the GLV ladder, via its own decorator), verdict (affine + compare).
+    They add zero ops — the provers see an identical jaxpr."""
+    with region_scope("point_decode"):
+        a = bytes_to_limbs(fields[:, 0])
+        b1 = bytes_to_limbs(fields[:, 1, :16], nlimb=10)
+        b2 = bytes_to_limbs(fields[:, 1, 16:], nlimb=10)
+        px = bytes_to_limbs(fields[:, 2])
+        t1 = bytes_to_limbs(fields[:, 3])
+
+        seven = jnp.broadcast_to(
+            jnp.asarray(_SEVEN_LIMBS).reshape(NLIMB, 1), px.shape
+        ).astype(px.dtype)
+        rhs = fe_add(fe_mul(fe_sqr(px), px), seven)  # x^3 + 7
+        ycand = fe_canon(fe_sqrt(rhs))
+        sq_ok = fe_is_zero(fe_sub(fe_mul(ycand, ycand), rhs))
+        odd = (ycand[0] & 1) == 1
+        yneg = fe_sub(jnp.zeros_like(ycand), ycand)  # weak rep is fine here
+        flip = odd != (want_odd == 1)
+        py = jnp.where(flip[None], yneg, ycand)
+        valid = valid & sq_ok
+        # Sanitize: invalid lanes (non-residue x — off-curve garbage) are
+        # replaced by the generator so EVERY lane runs on-curve group math.
+        # This keeps the explicitly-tracked infinity masks sound (off-curve
+        # orbits obey no group law and could hit Z ≡ 0 unflagged, which
+        # would zero the cross-lane batch-inversion product); the verdicts
+        # of these lanes are masked by `valid` regardless.
+        gxb = jnp.broadcast_to(
+            jnp.asarray(_GX_LIMBS).reshape(NLIMB, 1), px.shape
+        ).astype(px.dtype)
+        gyb = jnp.broadcast_to(
+            jnp.asarray(_GY_LIMBS).reshape(NLIMB, 1), px.shape
+        ).astype(px.dtype)
+        px = jnp.where(valid[None], px, gxb)
+        py = jnp.where(valid[None], py, gyb)
 
     X, Y, Z, r_inf = double_scalar_mult_glv(
         a, _digits128(b1), _digits128(b2), neg1 == 1, neg2 == 1, px, py
     )
-    x, y, inf = jacobian_to_affine(X, Y, Z, inf=r_inf)
 
-    nl = jnp.broadcast_to(
-        jnp.asarray(_N_LIMBS).reshape(NLIMB, 1), t1.shape
-    ).astype(t1.dtype)
-    t1n = fe_canon(t1 + nl, bounds=[2 * MASK] * NLIMB)  # r+n (< p when used)
-    ok_x = jnp.all(x == t1, axis=0) | (
-        (has_t2 == 1) & jnp.all(x == t1n, axis=0)
-    )
-    y_odd = (y[0] & 1) == 1
-    par_ok = (parity_req < 0) | (y_odd == (parity_req == 1))
-    return valid & ~inf & ok_x & par_ok
+    with region_scope("verdict"):
+        x, y, inf = jacobian_to_affine(X, Y, Z, inf=r_inf)
+
+        nl = jnp.broadcast_to(
+            jnp.asarray(_N_LIMBS).reshape(NLIMB, 1), t1.shape
+        ).astype(t1.dtype)
+        t1n = fe_canon(t1 + nl, bounds=[2 * MASK] * NLIMB)  # r+n (< p)
+        ok_x = jnp.all(x == t1, axis=0) | (
+            (has_t2 == 1) & jnp.all(x == t1n, axis=0)
+        )
+        y_odd = (y[0] & 1) == 1
+        par_ok = (parity_req < 0) | (y_odd == (parity_req == 1))
+        return valid & ~inf & ok_x & par_ok
 
 
+@named_region("verdict_checksum")
 def _verdict_checksum(ok):
     """Device-side verdict checksum: (count, position-weighted) int32 sums.
 
@@ -679,36 +689,39 @@ class TpuSecpVerifier:
         Returns (ok, needs, all_ok) — padded bool arrays and the sharded
         step's replicated verdict scalar (None off-mesh). Raises
         VerdictAnomaly on a buffer the guards reject."""
-        result = ticket.result
-        padded = int(ticket.args[0].shape[0])
-        all_ok = None
-        needs_raw = None
-        if isinstance(result, tuple):
-            if len(result) == 3:
-                ok_raw, needs_raw, all_ok = result
+        with region_scope("settle"):
+            result = ticket.result
+            padded = int(ticket.args[0].shape[0])
+            all_ok = None
+            needs_raw = None
+            if isinstance(result, tuple):
+                if len(result) == 3:
+                    ok_raw, needs_raw, all_ok = result
+                else:
+                    ok_raw, needs_raw = result
             else:
-                ok_raw, needs_raw = result
-        else:
-            ok_raw = result
-        ok_np = _faults.corrupt_verdict("jax_backend.verdict", np.asarray(ok_raw))
-        ok = _guards.validate_verdict(ok_np, padded, self._SITE)
-        needs = None
-        if needs_raw is not None:
-            needs = _guards.validate_verdict(
-                np.asarray(needs_raw), padded, self._SITE
+                ok_raw = result
+            ok_np = _faults.corrupt_verdict(
+                "jax_backend.verdict", np.asarray(ok_raw)
             )
-        _guards.check_sentinels(ticket.sset, ok, needs, self._SITE)
-        if ticket.aux is not None:
-            # Device sums were computed over the pristine in-flight
-            # buffer; recomputing from the materialized (possibly
-            # corrupted-in-transit) copy catches any single-lane flip —
-            # real-lane region included.
-            dev_sums = (int(np.asarray(ticket.aux[0])),
-                        int(np.asarray(ticket.aux[1])))
-            _guards.check_checksum(dev_sums, ok, self._SITE)
-        if all_ok is not None:
-            all_ok = bool(np.asarray(all_ok))
-        return ok, needs, all_ok
+            ok = _guards.validate_verdict(ok_np, padded, self._SITE)
+            needs = None
+            if needs_raw is not None:
+                needs = _guards.validate_verdict(
+                    np.asarray(needs_raw), padded, self._SITE
+                )
+            _guards.check_sentinels(ticket.sset, ok, needs, self._SITE)
+            if ticket.aux is not None:
+                # Device sums were computed over the pristine in-flight
+                # buffer; recomputing from the materialized (possibly
+                # corrupted-in-transit) copy catches any single-lane flip —
+                # real-lane region included.
+                dev_sums = (int(np.asarray(ticket.aux[0])),
+                            int(np.asarray(ticket.aux[1])))
+                _guards.check_checksum(dev_sums, ok, self._SITE)
+            if all_ok is not None:
+                all_ok = bool(np.asarray(all_ok))
+            return ok, needs, all_ok
 
     def _settle_device(self, ticket: _inflight.Ticket, count: int):
         """Settle one ticket through the in-flight queue's retry/

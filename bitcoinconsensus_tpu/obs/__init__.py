@@ -28,14 +28,28 @@ Three pieces:
   overlap-efficiency gauge), the reusable roofline/cost walk shared by
   the perf scripts, and provenance-stamped report comparison for the CI
   `perf-smoke` regression gate (`scripts/consensus_perf.py`).
+- ``xprof`` — the device-truth kernel observatory: programmatic
+  profiler capture sessions attributing device time to the named
+  kernel regions threaded through the kernels via `ops/regions.py`
+  (`consensus_kernel_region_seconds{region=...}` + MXU/VPU
+  busy-fraction gauges, `XPROF_r{N}.json` artifacts, the
+  `consensus_xprof.py --check` drift gate). Degrades to the op-walk
+  estimate on CPU containers under the same `comparable()` discipline.
+- ``flight`` — the black-box flight recorder: a bounded ring of recent
+  resilience events/spans/metric deltas, dumped redacted +
+  provenance-stamped on conviction (quarantine, checksum mismatch,
+  chaos conviction, explicit CLI flag). Disarmed by default; the hot
+  path costs one global read.
 
 Design constraint (hard): nothing in this package is ever imported by —
 or traced into — device kernel code. Instrumentation is host-side only,
 so the jaxpr determinism gate (`analysis/`) and every registered kernel
-jaxpr are untouched by telemetry. Conversely this is the ONE place in the
-tree allowed to read clocks: the host AST lint rejects direct
-`time.perf_counter()` timing in `models/` and `crypto/` so all timing
-flows through spans.
+jaxpr are untouched by telemetry. (`ops/regions.py` — imported by
+``xprof`` — is the one sanctioned kernel-adjacent dependency: pure
+naming metadata, importable both ways.) Conversely this is the ONE
+place in the tree allowed to read clocks: the host AST lint rejects
+direct `time.perf_counter()` timing in `models/` and `crypto/` so all
+timing flows through spans.
 
 Metric name catalogue and span taxonomy: README "Observability".
 """
@@ -58,10 +72,14 @@ from .spans import (
     span,
     trace_context,
 )
+from . import flight
 from . import perf
+from . import xprof
 
 __all__ = [
     "JsonlSink",
+    "flight",
+    "xprof",
     "MetricsRegistry",
     "Span",
     "add_sink",

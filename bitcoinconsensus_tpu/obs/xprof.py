@@ -1,0 +1,490 @@
+"""Device-truth kernel observatory: per-region device-time attribution.
+
+PR 9's phase timelines say where *wall* time goes between host seams;
+this module says which *kernel region* burns the device time inside a
+dispatch. Every consensus kernel executes under a ``region:<name>``
+``jax.named_scope`` (`ops/regions.py`), so the attribution needs no
+cooperation from the kernels themselves — the region names ride the
+jaxpr name stacks and, on real hardware, the XLA op metadata of every
+profiler trace event.
+
+Two capture modes, one artifact schema:
+
+- ``trace`` (TPU/GPU): a programmatic ``jax.profiler.trace`` session
+  around the workload; the chrome-trace events on the device tracks are
+  parsed and their durations charged to the innermost region in the op
+  name (`parse_trace_events`). This is measured device truth.
+- ``opwalk`` (CPU containers): the PR 9 op-walk estimate — each
+  program's jaxpr is walked (`walk_jaxpr_regions`: while×trips,
+  scan×length, sub-jaxpr recursion with region inheritance) and its
+  measured `timed_best` wall is split across regions by element-op
+  share, so region shares still sum to ~100% of captured time and the
+  same drift gate applies. The artifact's provenance stamps the mode
+  and hardware; `check_reports` follows `perf.comparable()` — a
+  container run never gates a TPU baseline, so CI never flaps.
+
+Both produce per-region ``consensus_kernel_region_seconds`` gauges,
+derived MXU/VPU busy-fraction gauges
+(``consensus_xprof_busy_fraction{unit=mxu|vpu}``), and a
+provenance-stamped ``XPROF_r{N}.json`` via `scripts/consensus_xprof.py`.
+
+Like everything in ``obs/``, nothing here is imported by kernel code;
+the one kernel-adjacent dependency is ``ops/regions.py``, which is
+dependency-free metadata by design.
+"""
+
+from __future__ import annotations
+
+import glob
+import gzip
+import json
+import os
+from contextlib import contextmanager
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+from .metrics import counter, gauge
+from . import perf as _perf
+from ..ops.regions import extract_regions
+
+__all__ = [
+    "UNATTRIBUTED",
+    "capture_report",
+    "check_reports",
+    "parse_trace_events",
+    "parse_trace_dir",
+    "standard_programs",
+    "light_programs",
+    "trace_session",
+    "walk_jaxpr_regions",
+    "write_report",
+]
+
+SCHEMA = "consensus-xprof-v1"
+
+# Bucket for device time/ops outside every region scope — kept explicit
+# (not silently dropped) so "shares sum to ~100%" is a checkable claim
+# and annotation erosion shows up as a growing unattributed share.
+UNATTRIBUTED = "unattributed"
+
+_REGION_SECONDS = gauge(
+    "consensus_kernel_region_seconds",
+    "device seconds attributed to each named kernel region by the last "
+    "xprof capture (trace mode: measured; opwalk mode: op-share estimate)",
+    ("region",),
+)
+_BUSY_FRACTION = gauge(
+    "consensus_xprof_busy_fraction",
+    "derived busy fraction of the MXU (dot/conv work) and VPU "
+    "(elementwise work) over the last capture's device time",
+    ("unit",),
+)
+_CAPTURES = counter(
+    "consensus_xprof_captures_total",
+    "xprof capture sessions, by mode",
+    ("mode",),
+)
+
+# Op names whose device time is systolic-array (MXU) work.
+_MXU_PRIMS = ("dot_general", "dot", "conv")
+
+
+# ---------------------------------------------------------------------------
+# opwalk mode: region-attributed jaxpr walk
+# ---------------------------------------------------------------------------
+
+
+def _eqn_regions(eqn) -> Tuple[str, ...]:
+    try:
+        stack = str(eqn.source_info.name_stack)
+    except Exception:  # pragma: no cover - jax internal move
+        return ()
+    return tuple(extract_regions(stack))
+
+
+def walk_jaxpr_regions(
+    jaxpr, inherited: Tuple[str, ...] = (), acc: Optional[dict] = None,
+    mult: int = 1,
+) -> Dict[Tuple[str, ...], Dict[str, int]]:
+    """Attribute a jaxpr's element ops to kernel-region stacks.
+
+    Returns ``{region_stack: {"ops": N, "mxu_flops": F}}`` where
+    ``region_stack`` is the tuple of region frames (outermost first; the
+    last entry is the innermost region the op is charged to — empty
+    tuple = unattributed). The op accounting mirrors `perf.walk_jaxpr`
+    (ARITH/MOVE element counts, while×trips, scan×length, recursion
+    into any param carrying a jaxpr) with one addition: sub-jaxprs
+    inherit the parent equation's region stack, because scan/while
+    bodies are re-traced without the caller's name stack.
+    """
+    import numpy as np
+
+    if acc is None:
+        acc = {}
+
+    def bucket(regions: Tuple[str, ...]) -> Dict[str, int]:
+        b = acc.get(regions)
+        if b is None:
+            b = acc[regions] = {"ops": 0, "mxu_ops": 0, "mxu_flops": 0}
+        return b
+
+    for eqn in jaxpr.eqns:
+        prim = eqn.primitive.name
+        regions = _eqn_regions(eqn) or inherited
+        if prim == "while":
+            walk_jaxpr_regions(
+                eqn.params["body_jaxpr"].jaxpr, regions, acc,
+                mult * _perf.while_trips(eqn),
+            )
+            continue
+        if prim == "scan":
+            walk_jaxpr_regions(
+                eqn.params["jaxpr"].jaxpr, regions, acc,
+                mult * eqn.params["length"],
+            )
+            continue
+        recursed = False
+        for p in eqn.params.values():
+            sub = getattr(p, "jaxpr", p if hasattr(p, "eqns") else None)
+            if sub is not None:
+                walk_jaxpr_regions(sub, regions, acc, mult)
+                recursed = True
+        if recursed:
+            continue
+        outs = sum(int(np.prod(v.aval.shape)) for v in eqn.outvars)
+        b = bucket(regions)
+        if prim == "dot_general":
+            lhs = eqn.invars[0].aval.shape
+            ((lc, _rc), _batch) = eqn.params["dimension_numbers"]
+            k = 1
+            for d in lc:
+                k *= int(lhs[d])
+            b["mxu_flops"] += 2 * k * outs * mult
+            b["mxu_ops"] += outs * mult
+            b["ops"] += outs * mult
+        elif prim in _perf.ARITH or prim in _perf.MOVE:
+            b["ops"] += outs * mult
+    return acc
+
+
+def _opwalk_program(name: str, fn: Callable, args: tuple, reps: int):
+    """One program's opwalk attribution: (region_acc, best_wall_s)."""
+    import jax
+
+    closed = jax.make_jaxpr(fn)(*args)
+    acc = walk_jaxpr_regions(closed.jaxpr)
+    jfn = jax.jit(fn)
+    jfn(*args)  # compile outside the timed window
+    best, _median, _walls = _perf.timed_best(lambda: jfn(*args), reps=reps)
+    return acc, best
+
+
+# ---------------------------------------------------------------------------
+# trace mode: chrome-trace event parsing
+# ---------------------------------------------------------------------------
+
+
+def _device_pids(events: Sequence[dict]) -> set:
+    """pids of device tracks in a chrome trace (process_name metadata
+    mentioning a device; XLA emits '/device:TPU:0' style names)."""
+    pids = set()
+    for ev in events:
+        if ev.get("ph") != "M" or ev.get("name") != "process_name":
+            continue
+        pname = str((ev.get("args") or {}).get("name", ""))
+        low = pname.lower()
+        if "/device:" in pname or "tpu" in low or "gpu" in low \
+                or "xla" in low:
+            pids.add(ev.get("pid"))
+    return pids
+
+
+def parse_trace_events(events: Sequence[dict]) -> dict:
+    """Attribute device-track complete events to kernel regions.
+
+    Returns ``{"regions": {leaf: seconds}, "phases": {outer: seconds},
+    "total_s": float, "mxu_s": float}``. Only ``ph == "X"`` events on
+    device-track pids count (durations are chrome-trace microseconds);
+    the region is the innermost ``region:`` frame in the event name or
+    its args (XLA op names carry the jaxpr name stack as a prefix).
+    Events with no region frame are charged to `UNATTRIBUTED`.
+    """
+    pids = _device_pids(events)
+    regions: Dict[str, float] = {}
+    phases: Dict[str, float] = {}
+    total = mxu = 0.0
+    for ev in events:
+        if ev.get("ph") != "X" or (pids and ev.get("pid") not in pids):
+            continue
+        dur = float(ev.get("dur", 0)) / 1e6
+        if dur <= 0:
+            continue
+        name = str(ev.get("name", ""))
+        hay = name
+        args = ev.get("args")
+        if isinstance(args, dict):
+            hay += " " + " ".join(str(v) for v in args.values())
+        frames = extract_regions(hay)
+        leaf = frames[-1] if frames else UNATTRIBUTED
+        outer = frames[0] if frames else UNATTRIBUTED
+        regions[leaf] = regions.get(leaf, 0.0) + dur
+        phases[outer] = phases.get(outer, 0.0) + dur
+        total += dur
+        low = name.lower()
+        if any(m in low for m in _MXU_PRIMS):
+            mxu += dur
+    return {"regions": regions, "phases": phases,
+            "total_s": total, "mxu_s": mxu}
+
+
+def parse_trace_dir(log_dir: str) -> dict:
+    """Parse every ``*.trace.json(.gz)`` under a profiler log dir and
+    merge the per-file `parse_trace_events` attributions."""
+    merged = {"regions": {}, "phases": {}, "total_s": 0.0, "mxu_s": 0.0}
+    paths = sorted(
+        glob.glob(os.path.join(log_dir, "**", "*.trace.json.gz"),
+                  recursive=True)
+        + glob.glob(os.path.join(log_dir, "**", "*.trace.json"),
+                    recursive=True)
+    )
+    for path in paths:
+        opener = gzip.open if path.endswith(".gz") else open
+        try:
+            with opener(path, "rt") as fh:
+                doc = json.load(fh)
+        except (OSError, ValueError):
+            continue
+        part = parse_trace_events(doc.get("traceEvents", []))
+        for key in ("regions", "phases"):
+            for k, v in part[key].items():
+                merged[key][k] = merged[key].get(k, 0.0) + v
+        merged["total_s"] += part["total_s"]
+        merged["mxu_s"] += part["mxu_s"]
+    return merged
+
+
+@contextmanager
+def trace_session(log_dir: str):
+    """A programmatic ``jax.profiler.trace`` session (the one sanctioned
+    wrapper — `utils/profiling.xla_trace` is a locked thin adapter over
+    this). Usable on every platform; on CPU the capture holds host
+    tracks only, which is why `capture_report` degrades to opwalk there.
+    """
+    import jax
+
+    with jax.profiler.trace(log_dir):
+        yield
+
+
+# ---------------------------------------------------------------------------
+# workload program sets
+# ---------------------------------------------------------------------------
+
+
+def light_programs(batch: int = 256) -> List[Tuple[str, Callable, tuple]]:
+    """Cheap-to-compile region workload: the fe_mul A/B pair, the BIP340
+    challenge kernel, and the verdict checksum. This is the mini-workload
+    leg (`consensus_stats.py`) and the unit-test set — no verify-kernel
+    compile."""
+    import numpy as np
+    import jax.numpy as jnp
+    from ..ops import limbs, mxu_mul, sha256
+
+    rng = np.random.default_rng(0xB17C015)
+    fe = lambda: jnp.asarray(rng.integers(  # noqa: E731
+        0, limbs.MASK + 1, size=(limbs.NLIMB, batch), dtype=np.int32))
+    a, b = fe(), fe()
+    u8 = lambda: jnp.asarray(rng.integers(  # noqa: E731
+        0, 256, size=(batch, 32), dtype=np.uint8))
+    ok = jnp.asarray(rng.integers(0, 2, size=(batch,)) == 1)
+    from ..crypto import jax_backend as _jb
+
+    return [
+        ("fe_mul", limbs.fe_mul, (a, b)),
+        ("fe_mul_onehot", mxu_mul.fe_mul_onehot, (a, b)),
+        ("bip340_challenge", sha256.bip340_challenge, (u8(), u8(), u8())),
+        ("verdict_checksum", _jb._verdict_checksum, (ok,)),
+    ]
+
+
+def standard_programs(batch: int = 256) -> List[Tuple[str, Callable, tuple]]:
+    """The full capture workload: `light_programs` plus the XLA verify
+    kernel itself (sighash prep -> point decode -> scalar mult ->
+    verdict chain). All-zero fields parse as off-curve and sanitize to
+    the generator, so every lane runs the full on-curve group math —
+    the kernel is data-independent by construction."""
+    import jax.numpy as jnp
+
+    from ..crypto import jax_backend as _jb
+
+    progs = light_programs(batch)
+    fields = jnp.zeros((batch, 4, 32), dtype=jnp.uint8)
+    z = jnp.zeros((batch,), dtype=jnp.int32)
+    progs.append((
+        "verify_kernel",
+        _jb._verify_kernel,
+        (fields, z, z, z, z, z, z.astype(bool)),
+    ))
+    return progs
+
+
+# ---------------------------------------------------------------------------
+# capture -> report
+# ---------------------------------------------------------------------------
+
+
+def _finalize(regions: Dict[str, float], phases: Dict[str, float],
+              total: float, mxu_s: float, mode: str,
+              programs: Dict[str, float], cmd: Optional[str]) -> dict:
+    unattr = regions.get(UNATTRIBUTED, 0.0)
+    named = {k: v for k, v in regions.items() if k != UNATTRIBUTED}
+    share = (lambda s: s / total if total > 0 else 0.0)
+    doc = {
+        "schema": SCHEMA,
+        "mode": mode,
+        "provenance": _perf.provenance(cmd=cmd),
+        "device_total_s": total,
+        "regions": {
+            k: {"seconds": v, "share": share(v)}
+            for k, v in sorted(named.items())
+        },
+        "phases": {
+            k: {"seconds": v, "share": share(v)}
+            for k, v in sorted(phases.items()) if k != UNATTRIBUTED
+        },
+        "unattributed_s": unattr,
+        "named_share": share(sum(named.values())),
+        "mxu_busy_fraction": share(mxu_s),
+        "vpu_busy_fraction": share(total - mxu_s),
+        "programs": {k: {"seconds": v} for k, v in sorted(programs.items())},
+    }
+    for k, v in named.items():
+        _REGION_SECONDS.set(v, region=k)
+    _REGION_SECONDS.set(unattr, region=UNATTRIBUTED)
+    _BUSY_FRACTION.set(doc["mxu_busy_fraction"], unit="mxu")
+    _BUSY_FRACTION.set(doc["vpu_busy_fraction"], unit="vpu")
+    _CAPTURES.inc(mode=mode)
+    return doc
+
+
+def capture_report(
+    programs: Optional[Sequence[Tuple[str, Callable, tuple]]] = None,
+    reps: int = 3,
+    mode: Optional[str] = None,
+    log_dir: Optional[str] = None,
+    cmd: Optional[str] = None,
+) -> dict:
+    """Run the workload under the active capture mode and return the
+    XPROF report dict (not yet written to disk — see `write_report`).
+
+    ``mode`` is ``"trace"`` on real accelerators and ``"opwalk"`` on CPU
+    unless forced. In trace mode the programs run inside one profiler
+    session and the device tracks are parsed; in opwalk mode each
+    program's jaxpr op counts split its measured wall time, so the
+    artifact never claims measured device truth a CPU container cannot
+    produce (the provenance + mode fields make the difference explicit,
+    and `check_reports` refuses cross-mode comparison).
+    """
+    import jax
+
+    if programs is None:
+        programs = standard_programs()
+    if mode is None:
+        mode = "opwalk" if jax.default_backend() == "cpu" else "trace"
+
+    regions: Dict[str, float] = {}
+    phases: Dict[str, float] = {}
+    prog_walls: Dict[str, float] = {}
+    total = mxu_s = 0.0
+
+    if mode == "trace":
+        import tempfile
+
+        own = log_dir is None
+        log_dir = log_dir or tempfile.mkdtemp(prefix="consensus_xprof_")
+        jitted = [(n, jax.jit(fn), args) for n, fn, args in programs]
+        for _n, jfn, args in jitted:  # compile outside the session
+            _perf.timed_best(lambda: jfn(*args), reps=1)
+        with trace_session(log_dir):
+            for name, jfn, args in jitted:
+                best, _m, _w = _perf.timed_best(
+                    lambda: jfn(*args), reps=reps)
+                prog_walls[name] = best
+        parsed = parse_trace_dir(log_dir)
+        regions, phases = parsed["regions"], parsed["phases"]
+        total, mxu_s = parsed["total_s"], parsed["mxu_s"]
+        if own:
+            import shutil
+
+            shutil.rmtree(log_dir, ignore_errors=True)
+    else:
+        for name, fn, args in programs:
+            acc, wall = _opwalk_program(name, fn, args, reps)
+            prog_walls[name] = wall
+            ops_total = sum(b["ops"] for b in acc.values()) or 1
+            for stack, b in acc.items():
+                sec = wall * (b["ops"] / ops_total)
+                leaf = stack[-1] if stack else UNATTRIBUTED
+                outer = stack[0] if stack else UNATTRIBUTED
+                regions[leaf] = regions.get(leaf, 0.0) + sec
+                phases[outer] = phases.get(outer, 0.0) + sec
+                mxu_s += wall * (b["mxu_ops"] / ops_total)
+            total += wall
+    return _finalize(regions, phases, total, mxu_s, mode, prog_walls, cmd)
+
+
+def write_report(doc: dict, path: str) -> None:
+    with open(path, "w") as fh:
+        json.dump(doc, fh, indent=2, sort_keys=True)
+        fh.write("\n")
+
+
+# Minimum share a region must hold before drift in it can gate, and the
+# maximum absolute share drift tolerated between same-provenance runs.
+SHARE_FLOOR = 0.01
+SHARE_TOLERANCE = 0.15
+
+
+def check_reports(
+    baseline: dict, report: dict,
+    tolerance: float = SHARE_TOLERANCE, floor: float = SHARE_FLOOR,
+) -> Optional[List[str]]:
+    """Region-share drift gate between two XPROF artifacts.
+
+    Returns None when the runs are not comparable (provenance mismatch
+    or different capture modes — same skip-not-fail discipline as
+    `perf.compare_reports`), else the list of drift findings (empty =
+    pass). A region drifts when its device-time share moved by more
+    than `tolerance` absolute points and at least one side holds more
+    than `floor` share — so a region appearing from or collapsing to
+    ~nothing is also a finding.
+    """
+    ok, _why = _perf.comparable(
+        baseline.get("provenance", {}), report.get("provenance", {}))
+    if not ok:
+        return None
+    if baseline.get("mode") != report.get("mode"):
+        return None
+    problems: List[str] = []
+    old = {k: v.get("share", 0.0)
+           for k, v in (baseline.get("regions") or {}).items()}
+    new = {k: v.get("share", 0.0)
+           for k, v in (report.get("regions") or {}).items()}
+    for k in sorted(set(old) | set(new)):
+        a, b = old.get(k, 0.0), new.get(k, 0.0)
+        if max(a, b) < floor:
+            continue
+        if abs(b - a) > tolerance:
+            problems.append(
+                f"region {k}: share {a:.1%} -> {b:.1%} "
+                f"(drift {abs(b - a):.1%} > {tolerance:.0%})"
+            )
+    old_named = baseline.get("named_share")
+    new_named = report.get("named_share")
+    if (isinstance(old_named, (int, float))
+            and isinstance(new_named, (int, float))
+            and new_named < old_named - tolerance):
+        problems.append(
+            f"named-region coverage dropped {old_named:.1%} -> "
+            f"{new_named:.1%} (annotations eroding)"
+        )
+    return problems

@@ -36,6 +36,7 @@ import numpy as np
 import jax.numpy as jnp
 from jax import lax
 
+from .regions import named_region
 from .limbs import (
     MASK,
     NLIMB,
@@ -103,6 +104,7 @@ def _col(vec: np.ndarray, like):
     return limb_const(vec).reshape((NLIMB,) + (1,) * (like.ndim - 1))
 
 
+@named_region("jacobian_double")
 def jacobian_double(X, Y, Z):
     """Point doubling, dbl-2009-l for a=0; maps infinity to infinity."""
     A = fe_sqr(X)
@@ -166,6 +168,7 @@ def _madd_lift(out, X1, x2, y2, z1_zero):
     return _select(z1_zero, lift, out)
 
 
+@named_region("jacobian_madd")
 def jacobian_madd_complete(X1, Y1, Z1, x2, y2, inf1=None):
     """Complete mixed addition (X1,Y1,Z1) + (x2,y2), (x2,y2) affine and
     never infinity. Branchless handling of every exceptional case; the
@@ -220,6 +223,7 @@ def _add_core(X1, Y1, Z1, X2, Y2, Z2, inf1):
     return (X3, Y3, Z3), h_zero, r_zero, z1_zero
 
 
+@named_region("jacobian_add")
 def jacobian_add_complete(X1, Y1, Z1, X2, Y2, Z2, inf2, inf1=None):
     """Complete Jacobian+Jacobian addition (add-2007-bl), branchless.
 
@@ -395,6 +399,7 @@ def _p_table(px, py):
     return TX, TY, TZ
 
 
+@named_region("scalar_mult")
 def double_scalar_mult(a, b, px, py):
     """R = a·G + b·P per lane (the ECDSA/Schnorr verify hot kernel).
 
@@ -448,6 +453,7 @@ def _digits128(limbs10, count: int = GLV_WINDOWS, width: int = P_WINDOW_BITS):
     return jnp.sum(b * weights, axis=1)
 
 
+@named_region("scalar_mult")
 def double_scalar_mult_glv(a, db1, db2, neg1, neg2, px, py):
     """R = a·G + (±b1 + lambda·(±b2))·P with the GLV-split schedule.
 
@@ -526,6 +532,7 @@ def double_scalar_mult_bits(a, b, px, py):
     return lax.fori_loop(0, 256, body, _inf_like(px))
 
 
+@named_region("to_affine")
 def jacobian_to_affine(X, Y, Z, inf=None):
     """(X, Y, Z) -> (x, y, is_infinity) with x, y canonical in [0, p).
 

@@ -50,6 +50,8 @@ import numpy as np
 
 import jax.numpy as jnp
 
+from .regions import named_region
+
 __all__ = [
     "NLIMB",
     "RADIX",
@@ -289,6 +291,7 @@ def _settle(x, bounds: Bounds):
     return x
 
 
+@named_region("fe_add")
 def fe_add(a, b):
     """a + b mod p (weak in, weak out)."""
     return _settle(a + b, [2 * w for w in W2])
@@ -318,6 +321,7 @@ _SUB_BIAS = _sub_bias_limbs()
 _SUB_BOUNDS = [int(d) + w for d, w in zip(_SUB_BIAS, W2, strict=True)]
 
 
+@named_region("fe_sub")
 def fe_sub(a, b):
     """a - b mod p (weak in/out): a + 32p(in >=W2-limb form) - b >= 0."""
     bias = limb_const(_SUB_BIAS).reshape((NLIMB,) + (1,) * (a.ndim - 1))
@@ -431,6 +435,7 @@ def _kara_combine(z0, b0, z2, b2, S, z1_true_bounds, h: int, out_len: int):
     return acc, bounds
 
 
+@named_region("fe_mul")
 def fe_mul(a, b):
     """a * b mod p (weak in, weak out): one-level Karatsuba over the limb
     convolution + parallel carry passes — the per-lane unit the whole
@@ -456,6 +461,7 @@ def fe_mul(a, b):
     return _settle(acc, bounds)
 
 
+@named_region("fe_sqr")
 def fe_sqr(a):
     """a^2 mod p: Karatsuba over the squaring convolution (three half
     squares; diagonals once, cross terms doubled)."""
@@ -539,6 +545,7 @@ def _exact_lt_2p(x, bounds: Bounds):
     return e2
 
 
+@named_region("fe_canon")
 def fe_canon(a, bounds: Bounds = None):
     """Weak -> canonical representative in [0, p), exact 13-bit limbs."""
     e = _exact_lt_2p(a, list(W2) if bounds is None else list(bounds))
@@ -563,6 +570,7 @@ def fe_canon(a, bounds: Bounds = None):
     return jnp.where(ge[None], sub, e)
 
 
+@named_region("fe_is_zero")
 def fe_is_zero(a, bounds: Bounds = None):
     """a ≡ 0 mod p? Returns (...,) bool (batch shape without limb axis)."""
     e = _exact_lt_2p(a, list(W2) if bounds is None else list(bounds))
@@ -662,6 +670,7 @@ def fe_pow_runs(x, e: int):
     return acc
 
 
+@named_region("fe_inv")
 def fe_inv(a):
     """a^(p-2) mod p (Fermat inverse; 0 -> 0).
 

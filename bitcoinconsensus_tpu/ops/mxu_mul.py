@@ -40,6 +40,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from .limbs import NLIMB, W2, _pad_rows, _settle
+from .regions import named_region
 
 _NCOL = 2 * NLIMB - 1  # schoolbook columns of an NLIMB x NLIMB product
 
@@ -99,6 +100,7 @@ def _conv_mxu(x, y):
     return v.astype(jnp.int32).T
 
 
+@named_region("fe_mul_onehot")
 def fe_mul_onehot(a, b):
     """a * b mod p via one-hot f32 MXU dots (weak in, weak out).
 

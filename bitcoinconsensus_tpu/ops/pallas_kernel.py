@@ -43,6 +43,7 @@ from jax import lax
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from .regions import named_region
 from .curve import (
     G_WINDOWS,
     G_WINDOW_BITS,
@@ -428,6 +429,7 @@ def _kernel_body(
 
 
 @functools.partial(jax.jit, static_argnames=("tile", "interpret"))
+@named_region("verify_tiles")
 def verify_tiles(
     fields, want_odd, parity_req, has_t2, neg1, neg2, valid,
     tile=LANE_TILE, interpret=False,

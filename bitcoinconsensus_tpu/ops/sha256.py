@@ -30,6 +30,8 @@ import numpy as np
 
 import jax.numpy as jnp
 
+from .regions import named_region
+
 __all__ = [
     "sha256_compress",
     "sha256_fixed",
@@ -74,6 +76,7 @@ def _shr(x, n: int):
     return x >> n
 
 
+@named_region("sha256_compress")
 def sha256_compress(state, block):
     """One SHA-256 compression: state (8, ...) uint32, block (16, ...)
     uint32 big-endian words. Returns the new (8, ...) state. Whole-array
@@ -199,6 +202,7 @@ def _py_rotr(x: int, n: int) -> int:
 CHALLENGE_MIDSTATE = tag_midstate("BIP0340/challenge")
 
 
+@named_region("sighash_prep")
 def bip340_challenge(r32, px32, m32):
     """Batched BIP340 challenge e = tagged(r.x ‖ pk.x ‖ m): (..., 32) uint8
     triples -> (..., 32) uint8 digests. Midstate skips the tag block; two

@@ -77,6 +77,7 @@ from ..obs import span as _obs_span
 from ..resilience import degrade as _degrade
 from ..resilience import faults as _faults
 from ..resilience import guards as _guards
+from ..ops.regions import region_scope
 from ..resilience.inflight import settle_array
 
 __all__ = ["make_mesh", "ShardedSecpVerifier", "make_sharded_step"]
@@ -198,20 +199,24 @@ def make_sharded_step(mesh: Mesh, use_pallas: Optional[bool] = None):
     local_kernel = _pick_backend(use_pallas)
 
     def local_step(fields, want_odd, parity_req, has_t2, neg1, neg2, valid, live):
-        per_lane, needs = local_kernel(
-            fields, want_odd, parity_req, has_t2, neg1, neg2, valid
-        )
-        # all-valid <=> no live lane DEFINITELY failed, on any shard
-        # (deferred lanes stay out; the host fixup ANDs their verdicts in).
-        failures = jnp.sum(jnp.where(live & ~per_lane & ~needs, 1, 0))
-        cnt, wsum = _verdict_checksum(per_lane)
-        return (
-            per_lane,
-            needs,
-            jax.lax.psum(failures, axis) == 0,
-            jnp.reshape(cnt, (1,)),
-            jnp.reshape(wsum, (1,)),
-        )
+        # region scope only — metadata for device-time attribution
+        # (obs/xprof); the traced program is unchanged.
+        with region_scope("shard_step"):
+            per_lane, needs = local_kernel(
+                fields, want_odd, parity_req, has_t2, neg1, neg2, valid
+            )
+            # all-valid <=> no live lane DEFINITELY failed, on any shard
+            # (deferred lanes stay out; the host fixup ANDs their
+            # verdicts in).
+            failures = jnp.sum(jnp.where(live & ~per_lane & ~needs, 1, 0))
+            cnt, wsum = _verdict_checksum(per_lane)
+            return (
+                per_lane,
+                needs,
+                jax.lax.psum(failures, axis) == 0,
+                jnp.reshape(cnt, (1,)),
+                jnp.reshape(wsum, (1,)),
+            )
 
     # Varying-axes checking is off: the verify kernel's scan carries start
     # as mesh-wide constants (infinity masks, G-table selects) and become

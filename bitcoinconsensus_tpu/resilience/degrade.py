@@ -35,6 +35,7 @@ import os
 from typing import Dict, List, Optional, Sequence, Tuple
 
 from ..obs import counter as _obs_counter
+from ..obs import flight as _flight
 from ..obs import gauge as _obs_gauge
 from ..obs import monotonic as _monotonic
 
@@ -128,6 +129,8 @@ class Ladder:
                 _REPROMOTIONS.inc(ladder=self.name, src=src, dst=level)
                 _LEVEL.set(self._idx, ladder=self.name)
                 self._fail_streak = 0
+                _flight.record("ladder.repromote", ladder=self.name,
+                               src=src, dst=level)
             return
         if ok:
             self._fail_streak = 0
@@ -145,6 +148,12 @@ class Ladder:
             self._fail_streak = 0
             _DEMOTIONS.inc(ladder=self.name, src=src, dst=self.current)
             _LEVEL.set(self._idx, ladder=self.name)
+            # Record the transition BEFORE triggering, so the dump's
+            # event window contains the demotion it is about.
+            _flight.record("ladder.demote", ladder=self.name,
+                           src=src, dst=self.current)
+            _flight.trigger("quarantine", ladder=self.name,
+                            src=src, dst=self.current)
 
 
 _SHARD_HEALTH = _obs_gauge(
@@ -221,6 +230,9 @@ class ShardLadder:
             self._evicted.append(device_id)
             self._fails[device_id] = 0
             _SHARD_HEALTH.set(len(self.healthy()), ladder="mesh")
+            _flight.record("shard.evict", device=device_id,
+                           healthy=len(self.healthy()))
+            _flight.trigger("quarantine", shard=device_id)
 
     def note_clean_dispatch(self) -> Optional[str]:
         """Record a fully clean mesh settle; maybe nominate a re-probe.
@@ -242,6 +254,8 @@ class ShardLadder:
             self._evicted.remove(device_id)
             self._fails[device_id] = 0
             _SHARD_HEALTH.set(len(self.healthy()), ladder="mesh")
+            _flight.record("shard.repromote", device=device_id,
+                           healthy=len(self.healthy()))
 
 
 class DispatchResilience:

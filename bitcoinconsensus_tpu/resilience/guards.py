@@ -115,6 +115,15 @@ class VerdictAnomaly(RuntimeError):
         super().__init__(msg)
         self.site = site
         self.reason = reason
+        # Single choke point for guard convictions: every anomaly lands
+        # in the flight ring; a checksum mismatch (verdict corruption in
+        # transit) is dump-worthy on its own, before the ladder reacts.
+        from ..obs import flight as _flight
+
+        _flight.record("guard.anomaly", site=site, reason=reason,
+                       detail=detail)
+        if reason == "checksum":
+            _flight.trigger("checksum", site=site, detail=detail)
 
 
 def validate_verdict(arr, n: int, site: str) -> np.ndarray:

@@ -54,6 +54,7 @@ from typing import Any, Callable, List, Optional, Tuple
 import numpy as np
 
 from ..obs import counter as _obs_counter
+from ..obs import flight as _flight
 from ..obs import current_trace as _current_trace
 from ..obs import gauge as _obs_gauge
 from ..obs import histogram as _obs_histogram
@@ -109,7 +110,10 @@ def settle_array(x) -> np.ndarray:
     is what makes "the pipeline overlaps" a checkable property instead
     of a hope.
     """
-    return np.asarray(x)
+    from ..ops.regions import region_scope
+
+    with region_scope("settle"):
+        return np.asarray(x)
 
 
 class Ticket:
@@ -278,6 +282,8 @@ class InflightQueue:
             ladder.report(ticket.level, False, probe=ticket.probe)
             if _monotonic() >= ticket.deadline:
                 _DEADLINE_EXPIRED.inc(site=self.site)
+                _flight.record("inflight.deadline_expired", site=self.site,
+                               attempts=ticket.attempts, level=ticket.level)
                 break
             if not res.may_retry(ticket.attempts, ticket.deadline, self.site):
                 break

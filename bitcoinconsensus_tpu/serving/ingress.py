@@ -56,6 +56,7 @@ from ..api import Error
 from ..core.script_error import ScriptError
 from ..models.batch import BatchItem, BatchResult
 from ..obs import counter as _obs_counter
+from ..obs import flight as _flight
 from ..obs import monotonic as _monotonic
 from ..resilience import faults as _faults
 from .server import OverloadError, PendingVerify, VerifyServer
@@ -117,6 +118,13 @@ _I_PROTO_ERRS = _obs_counter(
     "consensus_ingress_protocol_errors_total",
     "malformed/oversized/truncated frames (session closed, typed ERR sent)",
 )
+
+
+def _note_proto_err(kind: str) -> None:
+    """Count a protocol error and land it in the flight ring (the
+    recorder subscribes to ingress protocol errors by contract)."""
+    _I_PROTO_ERRS.inc()
+    _flight.record("ingress.proto_error", err=kind)
 
 
 def _env_float(name: str, default: float) -> float:
@@ -459,7 +467,7 @@ class IngressServer:
                 hdr = await self._read_exactly(sess, HEADER_LEN)
             except asyncio.IncompleteReadError as e:
                 if e.partial:  # died mid-header: a truncated frame
-                    _I_PROTO_ERRS.inc()
+                    _note_proto_err("truncated_header")
                 return  # clean EOF between frames: normal close
             except (asyncio.TimeoutError, TimeoutError):
                 _I_REAPS.inc()
@@ -468,7 +476,7 @@ class IngressServer:
                 return
             ftype, ln = decode_header(hdr)
             if ln > self.max_frame:
-                _I_PROTO_ERRS.inc()
+                _note_proto_err("oversized")
                 await self._send_err(
                     sess, 0, ERR_PROTO_OVERSIZED,
                     f"frame of {ln} bytes exceeds max_frame={self.max_frame}",
@@ -477,7 +485,7 @@ class IngressServer:
             try:
                 payload = await self._read_exactly(sess, ln)
             except asyncio.IncompleteReadError:
-                _I_PROTO_ERRS.inc()  # truncated frame: header promised more
+                _note_proto_err("truncated_frame")  # header promised more
                 return
             except (asyncio.TimeoutError, TimeoutError):
                 _I_REAPS.inc()  # slow-loris: started a frame, stalled
@@ -494,7 +502,7 @@ class IngressServer:
     ) -> bool:
         """Handle one inbound frame; False closes the session."""
         if ftype != FRAME_REQ:
-            _I_PROTO_ERRS.inc()
+            _note_proto_err("bad_type")
             await self._send_err(
                 sess, 0, ERR_PROTO_BAD_TYPE, f"unexpected frame type {ftype}"
             )
@@ -502,7 +510,7 @@ class IngressServer:
         try:
             rid, tenant, item = decode_request(payload)
         except (ValueError, UnicodeDecodeError, OverflowError) as e:
-            _I_PROTO_ERRS.inc()
+            _note_proto_err("malformed")
             await self._send_err(sess, 0, ERR_PROTO_MALFORMED, str(e))
             return False
         try:

@@ -39,6 +39,7 @@ import threading
 from collections import deque
 from typing import Optional
 
+from ..obs import flight as _flight
 from ..obs import gauge as _obs_gauge
 from ..obs import histogram as _obs_histogram
 
@@ -72,6 +73,17 @@ _SLO_GAUGE = _obs_gauge(
     "batch settle-latency quantile estimates driving admission",
     ("q",),
 )
+# Exposition-friendly plain-gauge aliases of the same two quantiles —
+# admission-internal until PR 17; dashboards and REQUIRED_METRICS want
+# stable unlabeled names (`consensus_stats.py`).
+_SLO_P50 = _obs_gauge(
+    "consensus_serving_slo_p50_seconds",
+    "sliding-window p50 batch settle latency (admission estimator)",
+)
+_SLO_P99 = _obs_gauge(
+    "consensus_serving_slo_p99_seconds",
+    "sliding-window p99 batch settle latency (admission estimator)",
+)
 
 DEFAULT_SLO_WINDOW = 128
 
@@ -99,8 +111,11 @@ class SloTracker:
         self._hist.observe(seconds)
         with self._lock:
             self._window.append(float(seconds))
-        self._p50.set(self.quantile(0.5))
-        self._p99.set(self.quantile(0.99))
+        p50, p99 = self.quantile(0.5), self.quantile(0.99)
+        self._p50.set(p50)
+        self._p99.set(p99)
+        _SLO_P50.set(p50)
+        _SLO_P99.set(p99)
 
     def quantile(self, q: float) -> Optional[float]:
         """Upper sample quantile of the window: the smallest observed
@@ -166,5 +181,7 @@ class AdmissionController:
             return None
         batches_ahead = backlog // self.batch_capacity + 1
         if batches_ahead * p99 > self.deadline_budget_s():
+            _flight.record("shed", reason=SHED_SLO, backlog=backlog,
+                           p99=p99, budget_s=self.deadline_budget_s())
             return SHED_SLO
         return None

@@ -77,12 +77,16 @@ class Phases:
 
 @contextmanager
 def xla_trace(log_dir: str = "/tmp/bitcoinconsensus_tpu_trace"):
-    """XLA/TPU profiler hook: wraps a region in `jax.profiler.trace` so
-    device-side timing (kernel occupancy, transfers) lands in a
-    TensorBoard-readable trace under `log_dir`. Complements the host-side
-    span attribution; used by `scripts/profile_verify.py --xla-trace`."""
-    import jax
+    """XLA/TPU profiler hook (LOCKED thin adapter — same CLI surface as
+    always, used by `scripts/profile_verify.py --xla-trace`).
 
-    with jax.profiler.trace(log_dir):
+    The actual capture session lives in `obs/xprof.trace_session`, the
+    device-truth observatory that also parses these traces into
+    per-region attribution; this wrapper only keeps the historical
+    entry point and its print. New profiling code should call
+    `obs.xprof` directly."""
+    from ..obs.xprof import trace_session
+
+    with trace_session(log_dir):
         yield
     print(f"xla trace written to {log_dir}")

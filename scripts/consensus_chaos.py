@@ -31,6 +31,12 @@ Swept classes (see resilience/faults.py for the site registry):
                          through `verify_checks_begin/finish` — the
                          async pipeline must settle fail-closed too
 
+The flight-recorder trial arms the black box (obs/flight) around a
+persistent conviction: a quarantine MUST produce a redacted
+`flight_dump_quarantine_*.json` containing the convicting guard event,
+the ladder transition it forced, and the surrounding span window —
+all hard pass criteria.
+
 Single-lane `flip` inside the real-lane region is a **hard pass
 criterion**: the device-side verdict checksum recomputed at the settle
 seam (resilience/guards.check_checksum) detects any single flip and any
@@ -152,6 +158,77 @@ def _async_trial(name, checks, oracle, specs, seed):
         ),
         "ladder_end": v._resilience.ladder.current,
     }
+
+
+def _flight_trial(checks, oracle, seed):
+    """Conviction -> complete flight dump (HARD criterion).
+
+    The flight recorder is armed around a persistent verdict-corruption
+    run that must quarantine the device rung; the quarantine trigger's
+    dump is read back and must contain the convicting guard event, the
+    ladder transition it forced, and the surrounding span window — the
+    black box's whole contract, exercised on the real conviction path
+    rather than a synthetic trigger.
+    """
+    import glob as globlib
+    import tempfile
+
+    from bitcoinconsensus_tpu.crypto.jax_backend import TpuSecpVerifier
+    from bitcoinconsensus_tpu.obs import flight
+    from bitcoinconsensus_tpu.resilience import FaultPlan, FaultSpec, inject
+
+    fdir = tempfile.mkdtemp(prefix="chaos-flight-")
+    old_dir = os.environ.get("BITCOINCONSENSUS_TPU_FLIGHT_DIR")
+    os.environ["BITCOINCONSENSUS_TPU_FLIGHT_DIR"] = fdir
+    flight.set_enabled(True)
+    flight.reset()
+    try:
+        v = TpuSecpVerifier(min_batch=8)
+        # Warm clean pass: the first dispatch of a shape pays the XLA
+        # compile, which on a cold cache blows the 2s retry deadline —
+        # the ticket would contain to host after ONE failure and the
+        # ladder would never demote, so no quarantine ever triggers.
+        warm = np.asarray(v.verify_checks(checks), dtype=bool)
+        assert np.array_equal(warm, oracle)
+        plan = FaultPlan(
+            [FaultSpec("jax_backend.verdict", "garbage", count=64)]
+        )
+        with inject(plan, seed=seed) as inj:
+            out = np.asarray(v.verify_checks(checks), dtype=bool)
+    finally:
+        flight.set_enabled(False)
+        if old_dir is None:
+            os.environ.pop("BITCOINCONSENSUS_TPU_FLIGHT_DIR", None)
+        else:
+            os.environ["BITCOINCONSENSUS_TPU_FLIGHT_DIR"] = old_dir
+
+    row = {
+        "trial": "flight-conviction-dump",
+        "fired": {f"{s}:{k}": c for (s, k), c in sorted(inj.fired.items())},
+        "fault_fired": inj.total_fired() >= 1,
+        "bit_identical": bool(np.array_equal(out, oracle)),
+        "quarantined_to_host": v._resilience.ladder.current == "host",
+    }
+    dumps = sorted(globlib.glob(
+        os.path.join(fdir, "flight_dump_quarantine_*.json")))
+    row["flight_dump_written"] = bool(dumps)
+    if dumps:
+        with open(dumps[-1], encoding="utf-8") as fh:
+            doc = json.load(fh)
+        kinds = [e.get("kind") for e in doc.get("events", [])]
+        row["dump_has_conviction"] = "guard.anomaly" in kinds
+        row["dump_has_ladder_transition"] = "ladder.demote" in kinds
+        row["dump_has_span_window"] = "span" in kinds
+        row["dump_schema_ok"] = (
+            doc.get("schema") == flight.SCHEMA
+            and "provenance" in doc and "metric_deltas" in doc
+        )
+        row["dump_events"] = len(kinds)
+    else:
+        for key in ("dump_has_conviction", "dump_has_ladder_transition",
+                    "dump_has_span_window", "dump_schema_ok"):
+            row[key] = False
+    return row
 
 
 def _mesh_trial(checks, oracle, seed):
@@ -603,6 +680,10 @@ def run_sweep(seed: int) -> dict:
     )
     persistent["quarantined_to_host"] = persistent["ladder_end"] == "host"
     trials.append(persistent)
+
+    # Flight recorder: the same persistent conviction, with the black
+    # box armed — the quarantine dump must tell the whole story.
+    trials.append(_flight_trial(checks, oracle_v, seed))
 
     trials.append(_mesh_trial(checks, oracle_v, seed))
 
@@ -1865,6 +1946,12 @@ def _problems(report: dict) -> list:
         if t["trial"] != "clean" and t.get("fault_fired") is False:
             probs.append(f"{t['trial']}: armed fault never fired (dead site?)")
         for key in ("verdict_correct", "quarantined_to_host",
+                    # flight-recorder hard criteria: a conviction must
+                    # yield a dump holding the convicting event, its
+                    # ladder transition, and the span window around it
+                    "flight_dump_written", "dump_has_conviction",
+                    "dump_has_ladder_transition", "dump_has_span_window",
+                    "dump_schema_ok",
                     "flip_caught_by_checksum", "deadline_convicted",
                     "eviction_happened", "continued_bit_identical",
                     "repromoted",

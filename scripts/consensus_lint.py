@@ -100,6 +100,15 @@ def main() -> int:
     host_ok = not findings
     print(f"  {'clean' if host_ok else f'{len(findings)} finding(s)'}")
 
+    print("\n== kernel region-annotation coverage (xprof attributability) ==")
+    region_findings = host_lint.lint_kernel_regions(
+        include_heavy=not args.quick)
+    for f in region_findings:
+        print(f"  {f}")
+    print(f"  {'clean' if not region_findings else f'{len(region_findings)} finding(s)'}")
+    host_ok = host_ok and not region_findings
+    findings = findings + region_findings
+
     print("\n== kernel interval prover + determinism gate ==")
     all_ok = host_ok
     reports = []

@@ -103,6 +103,8 @@ REQUIRED_METRICS = [
     "consensus_serving_batch_fill",
     "consensus_serving_batch_seconds",
     "consensus_serving_slo_seconds",
+    "consensus_serving_slo_p50_seconds",
+    "consensus_serving_slo_p99_seconds",
     "consensus_serving_batches_total",
     # network ingress (the workload's socket leg: one verified round
     # trip, one garbage frame, one reaped slow-loris; the write-error
@@ -128,6 +130,18 @@ REQUIRED_METRICS = [
     "consensus_gauntlet_replay_blocks_total",
     "consensus_gauntlet_fuzz_cases_total",
     "consensus_gauntlet_shape_seconds",
+    # device-truth observatory (the workload's capture leg runs the
+    # op-walk degradation of the xprof trace on CPU; the same gauges
+    # carry real profiler attribution on accelerators)
+    "consensus_kernel_region_seconds",
+    "consensus_xprof_busy_fraction",
+    "consensus_xprof_captures_total",
+    # flight recorder (armed for the capture leg with one explicit
+    # trigger; conviction-path triggers light up under
+    # scripts/consensus_chaos.py)
+    "consensus_flight_armed",
+    "consensus_flight_events_total",
+    "consensus_flight_dumps_total",
     # spans
     "consensus_span_duration_seconds",
 ]
@@ -319,6 +333,26 @@ def run_mini_workload() -> None:
     assert crep["pinned"], crep["mismatches"]
     frep = run_diff_fuzz(seed=1, n_cases=8)
     assert frep["bit_identical"], frep["divergences"]
+
+    # --- device-truth observatory + flight recorder: a tiny capture
+    # (the op-walk degradation on CPU containers, the profiler trace on
+    # accelerators) lights the region/busy-fraction gauges; the armed
+    # recorder subscribes to spans and one explicit trigger dumps the
+    # ring to a throwaway dir, sampling the flight counters end to end ---
+    from bitcoinconsensus_tpu.obs import flight, spans, xprof
+
+    flight.set_enabled(True)
+    try:
+        xdoc = xprof.capture_report(
+            programs=xprof.light_programs(batch=8), reps=1)
+        assert xdoc["named_share"] > 0.95, xdoc
+        with spans.span("stats.flight_leg"):
+            pass  # one span through the armed sink -> ring event
+        fdir = tempfile.mkdtemp(prefix="stats-flight-")
+        dump = flight.trigger("stats", out_dir=fdir)
+        assert dump is not None and os.path.exists(dump), dump
+    finally:
+        flight.set_enabled(False)
 
 
 def main(argv=None) -> int:

@@ -1,0 +1,154 @@
+"""Device-truth kernel observatory CLI: capture + region table + gate.
+
+Runs the region-annotated kernel workload under `obs/xprof.capture_report`
+(a programmatic profiler trace on real accelerators; the op-walk
+estimate on CPU containers — the `mode` field and provenance stamp make
+the difference explicit) and emits one provenance-stamped artifact:
+
+    {schema, mode, provenance{platform, device_kind, ...},
+     device_total_s, regions{name: {seconds, share}}, phases{...},
+     unattributed_s, named_share, mxu_busy_fraction, vpu_busy_fraction}
+
+`--check` compares region shares against the highest-numbered
+XPROF_r{N}.json in the repo root and EXITS NONZERO on drift beyond
+tolerance — unless the provenance or capture mode is not comparable, in
+which case the comparison is explicitly skipped (same discipline as
+`consensus_perf.py --check`: a CPU container run never fails a TPU
+baseline).
+
+    JAX_PLATFORMS=cpu python scripts/consensus_xprof.py --out XPROF_ci.json --check
+    python scripts/consensus_xprof.py --full --out XPROF_r18.json   # on TPU
+
+`--full` includes the verify-kernel program (a large compile); the
+default light set (fe_mul A/B, BIP340 challenge, verdict checksum) is
+the CI smoke shape. `--flight-dump` arms the flight recorder for the
+capture and forces a `flight_dump_cli_*.json` at the end — the explicit
+CLI trigger of the recorder's contract.
+"""
+
+import argparse
+import json
+import os
+import re
+import sys
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _find_baseline(exclude):
+    best_n, best_path = -1, None
+    pat = re.compile(r"^XPROF_r(\d+)\.json$")
+    for name in os.listdir(ROOT):
+        m = pat.match(name)
+        path = os.path.join(ROOT, name)
+        if m and os.path.abspath(path) != os.path.abspath(exclude or ""):
+            n = int(m.group(1))
+            if n > best_n:
+                best_n, best_path = n, path
+    return best_path
+
+
+def _region_table(doc) -> str:
+    lines = [f"mode={doc['mode']}  device_total="
+             f"{doc['device_total_s'] * 1e3:.3f}ms  named_share="
+             f"{doc['named_share']:.1%}  mxu={doc['mxu_busy_fraction']:.1%}"
+             f"  vpu={doc['vpu_busy_fraction']:.1%}"]
+    lines.append(f"{'region':24s} {'seconds':>12s} {'share':>8s}")
+    rows = sorted(doc["regions"].items(),
+                  key=lambda kv: -kv[1]["seconds"])
+    for name, r in rows:
+        lines.append(f"{name:24s} {r['seconds']:12.6f} {r['share']:8.1%}")
+    if doc.get("unattributed_s"):
+        lines.append(f"{'(unattributed)':24s} "
+                     f"{doc['unattributed_s']:12.6f} "
+                     f"{1.0 - doc['named_share']:8.1%}")
+    return "\n".join(lines)
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--batch", type=int, default=256,
+                    help="lane count per capture program")
+    ap.add_argument("--reps", type=int, default=3,
+                    help="timed repetitions per program")
+    ap.add_argument("--full", action="store_true",
+                    help="include the verify-kernel program (large compile)")
+    ap.add_argument("--mode", choices=("trace", "opwalk"), default=None,
+                    help="force the capture mode (default: trace on "
+                    "accelerators, opwalk on CPU)")
+    ap.add_argument("--out", default=None, help="write the artifact here")
+    ap.add_argument("--check", action="store_true",
+                    help="drift-gate against the newest XPROF_r{N}.json")
+    ap.add_argument("--tolerance", type=float, default=None,
+                    help="absolute region-share drift tolerance for --check")
+    ap.add_argument("--min-named-share", type=float, default=0.95,
+                    help="fail the capture when less than this fraction of "
+                    "device time is attributed to named regions")
+    ap.add_argument("--flight-dump", action="store_true",
+                    help="arm the flight recorder and force a CLI-triggered "
+                    "dump after the capture")
+    args = ap.parse_args()
+
+    from bitcoinconsensus_tpu.obs import flight, xprof
+
+    if args.flight_dump:
+        flight.set_enabled(True)
+
+    programs = (xprof.standard_programs(args.batch) if args.full
+                else xprof.light_programs(args.batch))
+    doc = xprof.capture_report(
+        programs=programs, reps=args.reps, mode=args.mode,
+    )
+    print(_region_table(doc), file=sys.stderr)
+
+    status = 0
+    if doc["named_share"] < args.min_named_share:
+        print(f"FAIL: named-region share {doc['named_share']:.1%} < "
+              f"{args.min_named_share:.0%} — kernels are losing their "
+              f"region annotations", file=sys.stderr)
+        status = 1
+
+    if args.check:
+        baseline_path = _find_baseline(exclude=args.out)
+        if baseline_path is None:
+            print("check: no XPROF_r{N}.json baseline found — skipping",
+                  file=sys.stderr)
+        else:
+            with open(baseline_path) as fh:
+                baseline = json.load(fh)
+            kw = {}
+            if args.tolerance is not None:
+                kw["tolerance"] = args.tolerance
+            problems = xprof.check_reports(baseline, doc, **kw)
+            if problems is None:
+                print(f"check: not comparable (provenance/mode) — skipping "
+                      f"vs {os.path.basename(baseline_path)}",
+                      file=sys.stderr)
+            elif problems:
+                for p in problems:
+                    print(f"FAIL: {p}", file=sys.stderr)
+                print(f"check: {len(problems)} drift(s) vs "
+                      f"{os.path.basename(baseline_path)}", file=sys.stderr)
+                status = 1
+            else:
+                print(f"check: OK vs {os.path.basename(baseline_path)}",
+                      file=sys.stderr)
+
+    if args.flight_dump:
+        path = flight.trigger("cli", capture_mode=doc["mode"])
+        print(f"flight dump: {path}", file=sys.stderr)
+        if path is None:
+            print("FAIL: flight recorder armed but produced no dump",
+                  file=sys.stderr)
+            status = 1
+
+    print(json.dumps(doc, indent=2, sort_keys=True))
+    if args.out:
+        xprof.write_report(doc, args.out)
+    return status
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,292 @@
+"""Device-truth kernel observatory: region naming, region-attributed
+jaxpr walks, chrome-trace parsing against a checked-in fixture, the
+opwalk capture's shares-sum property, the drift gate's skip-not-fail
+discipline, and the region-coverage lint (positive + negative fixture).
+
+The contract (README "Device profiling & flight recorder"): every
+consensus kernel executes under a ``region:<name>`` scope, so both
+capture modes can attribute ~100% of device time to named regions, and
+an artifact is only ever gated against a same-provenance, same-mode
+baseline.
+"""
+
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from conftest import *  # noqa: F401,F403 (env setup)
+
+from bitcoinconsensus_tpu.obs import get_registry
+from bitcoinconsensus_tpu.obs import xprof as X
+from bitcoinconsensus_tpu.ops import limbs as L
+from bitcoinconsensus_tpu.ops import regions as R
+
+DATA = os.path.join(os.path.dirname(os.path.abspath(__file__)), "data")
+
+
+# ---------------------------------------------------------------------------
+# ops/regions naming metadata.
+
+
+def test_region_name_and_extraction():
+    assert R.region_name("fe_mul") == "region:fe_mul"
+    stack = "jit_f/region:scalar_mult/region:fe_mul/mul.3"
+    assert R.extract_regions(stack) == ["scalar_mult", "fe_mul"]
+    assert R.extract_region(stack) == "fe_mul"
+    assert R.extract_regions("jit_f/transpose/mul.3") == []
+    assert R.extract_region("no regions here") is None
+
+
+def test_named_region_decorator_tags_jaxpr():
+    @R.named_region("toy_region")
+    def f(x):
+        return x * 2 + 1
+
+    assert f.__consensus_region__ == "toy_region"
+    closed = jax.make_jaxpr(f)(jnp.arange(4))
+    acc = X.walk_jaxpr_regions(closed.jaxpr)
+    named = sum(b["ops"] for s, b in acc.items() if s)
+    total = sum(b["ops"] for b in acc.values())
+    assert total > 0 and named == total
+    assert all(s[-1] == "toy_region" for s in acc if s)
+
+
+def test_scan_body_inherits_enclosing_region():
+    """scan/while bodies are re-traced without the caller's name stack;
+    the walk must charge their ops to the inherited region."""
+
+    @R.named_region("scan_owner")
+    def f(x):
+        def body(c, _):
+            return c * 2 + 1, ()
+
+        out, _ = jax.lax.scan(body, x, None, length=5)
+        return out
+
+    closed = jax.make_jaxpr(f)(jnp.arange(4))
+    acc = X.walk_jaxpr_regions(closed.jaxpr)
+    named = sum(b["ops"] for s, b in acc.items() if s)
+    total = sum(b["ops"] for b in acc.values())
+    assert named == total
+    # scan multiplies body ops by length: 2 eqns x 4 elems x 5 trips.
+    assert total >= 2 * 4 * 5
+
+
+def test_consensus_kernels_are_annotated():
+    """The real kernels carry their regions: fe_mul A/B attribution."""
+    a = jnp.ones((L.NLIMB, 4), jnp.int32)
+    closed = jax.make_jaxpr(L.fe_mul)(a, a)
+    acc = X.walk_jaxpr_regions(closed.jaxpr)
+    leaves = {s[-1] for s in acc if s}
+    assert "fe_mul" in leaves
+    named = sum(b["ops"] for s, b in acc.items() if s)
+    total = sum(b["ops"] for b in acc.values())
+    assert named / total > 0.95
+
+
+# ---------------------------------------------------------------------------
+# Chrome-trace parsing vs the checked-in fixture.
+
+
+def _fixture_events():
+    with open(os.path.join(DATA, "xprof_fixture.trace.json")) as fh:
+        return json.load(fh)["traceEvents"]
+
+
+def test_parse_trace_events_fixture_attribution():
+    out = X.parse_trace_events(_fixture_events())
+    # Only the four device-track events count: 1000+500+250+250 us.
+    assert out["total_s"] == pytest.approx(0.002)
+    assert out["regions"]["fe_mul"] == pytest.approx(0.001)
+    assert out["regions"]["fe_mul_onehot"] == pytest.approx(0.0005)
+    assert out["regions"]["sighash_prep"] == pytest.approx(0.00025)
+    assert out["regions"][X.UNATTRIBUTED] == pytest.approx(0.00025)
+    # Outermost frame rolls up both fe_mul variants under scalar_mult.
+    assert out["phases"]["scalar_mult"] == pytest.approx(0.0015)
+    assert out["phases"]["sighash_prep"] == pytest.approx(0.00025)
+    # Only the dot_general event is MXU time.
+    assert out["mxu_s"] == pytest.approx(0.0005)
+
+
+def test_parse_trace_events_host_and_zero_dur_ignored():
+    out = X.parse_trace_events(_fixture_events())
+    # The 99999us host-track event and the 0-dur event must not leak in.
+    assert out["total_s"] < 0.01
+    assert out["regions"]["fe_mul"] < 0.09
+
+
+def test_parse_trace_dir_merges_plain_and_gzip(tmp_path):
+    import gzip
+    import shutil
+
+    src = os.path.join(DATA, "xprof_fixture.trace.json")
+    shutil.copy(src, tmp_path / "a.trace.json")
+    with open(src, "rb") as fh, gzip.open(
+            tmp_path / "b.trace.json.gz", "wb") as gz:
+        gz.write(fh.read())
+    (tmp_path / "junk.trace.json").write_text("{not json")
+    merged = X.parse_trace_dir(str(tmp_path))
+    # Two parseable copies -> every attribution doubles; junk skipped.
+    assert merged["total_s"] == pytest.approx(0.004)
+    assert merged["regions"]["fe_mul"] == pytest.approx(0.002)
+    assert merged["mxu_s"] == pytest.approx(0.001)
+
+
+# ---------------------------------------------------------------------------
+# Opwalk capture: shares sum to ~100%, gauges light up.
+
+
+def test_capture_report_opwalk_shares_sum_property():
+    doc = X.capture_report(
+        programs=X.light_programs(batch=8), reps=1, mode="opwalk")
+    assert doc["schema"] == X.SCHEMA and doc["mode"] == "opwalk"
+    total = doc["device_total_s"]
+    assert total > 0
+    named_s = sum(r["seconds"] for r in doc["regions"].values())
+    # Shares sum to ~100%: named + unattributed == total by construction.
+    assert named_s + doc["unattributed_s"] == pytest.approx(total)
+    share_sum = sum(r["share"] for r in doc["regions"].values())
+    assert share_sum + doc["unattributed_s"] / total == pytest.approx(1.0)
+    assert doc["named_share"] >= 0.95  # the acceptance bar
+    # The A/B pair is separately attributable, plus the other kernels.
+    for region in ("fe_mul", "fe_mul_onehot", "sighash_prep",
+                   "verdict_checksum"):
+        assert region in doc["regions"], sorted(doc["regions"])
+    # The one-hot candidate runs dot_generals -> nonzero MXU fraction.
+    assert 0.0 < doc["mxu_busy_fraction"] < 1.0
+    assert doc["mxu_busy_fraction"] + doc["vpu_busy_fraction"] == (
+        pytest.approx(doc["named_share"] + doc["unattributed_s"] / total))
+    # Gauges + capture counter lit up.
+    snap = get_registry().snapshot()
+    assert any(s["labels"].get("region") == "fe_mul_onehot"
+               for s in snap["consensus_kernel_region_seconds"]["samples"])
+    assert any(s["labels"].get("unit") == "mxu"
+               for s in snap["consensus_xprof_busy_fraction"]["samples"])
+    assert any(s["labels"].get("mode") == "opwalk" and s["value"] >= 1
+               for s in snap["consensus_xprof_captures_total"]["samples"])
+
+
+def test_write_report_roundtrip(tmp_path):
+    doc = X.capture_report(
+        programs=X.light_programs(batch=8), reps=1, mode="opwalk")
+    path = tmp_path / "XPROF_test.json"
+    X.write_report(doc, str(path))
+    assert json.loads(path.read_text()) == json.loads(json.dumps(doc))
+
+
+# ---------------------------------------------------------------------------
+# Drift gate: same-provenance compare, skip-not-fail otherwise.
+
+
+def _mk_report(regions, named_share=0.99, mode="opwalk", platform="cpu",
+               device_kind="cpu/x86-8c"):
+    return {
+        "schema": X.SCHEMA,
+        "mode": mode,
+        "provenance": {"platform": platform, "device_kind": device_kind},
+        "regions": {k: {"seconds": v, "share": v} for k, v in regions.items()},
+        "named_share": named_share,
+    }
+
+
+def test_check_reports_flags_share_drift():
+    base = _mk_report({"fe_mul": 0.5, "sha256_compress": 0.5})
+    drifted = _mk_report({"fe_mul": 0.1, "sha256_compress": 0.9})
+    problems = X.check_reports(base, drifted)
+    assert problems and any("fe_mul" in p for p in problems)
+    assert any("sha256_compress" in p for p in problems)
+
+
+def test_check_reports_passes_within_tolerance():
+    base = _mk_report({"fe_mul": 0.50, "sha256_compress": 0.50})
+    near = _mk_report({"fe_mul": 0.45, "sha256_compress": 0.55})
+    assert X.check_reports(base, near) == []
+
+
+def test_check_reports_ignores_sub_floor_regions():
+    base = _mk_report({"fe_mul": 0.995, "tiny": 0.005})
+    new = _mk_report({"fe_mul": 0.999, "tiny": 0.0})
+    assert X.check_reports(base, new) == []
+
+
+def test_check_reports_flags_named_share_erosion():
+    base = _mk_report({"fe_mul": 1.0}, named_share=0.99)
+    eroded = _mk_report({"fe_mul": 1.0}, named_share=0.5)
+    problems = X.check_reports(base, eroded)
+    assert problems and any("coverage dropped" in p for p in problems)
+
+
+def test_check_reports_skips_on_provenance_or_mode_mismatch():
+    base = _mk_report({"fe_mul": 1.0})
+    other_hw = _mk_report({"fe_mul": 0.1}, device_kind="TPU v5e")
+    assert X.check_reports(base, other_hw) is None
+    other_mode = _mk_report({"fe_mul": 0.1}, mode="trace")
+    assert X.check_reports(base, other_mode) is None
+
+
+# ---------------------------------------------------------------------------
+# Region-coverage lint: registry kernels pass, a bare toy is a finding.
+
+
+def test_lint_kernel_regions_clean_on_registry():
+    from bitcoinconsensus_tpu.analysis import host_lint
+
+    assert host_lint.lint_kernel_regions(include_heavy=False) == []
+
+
+def test_lint_kernel_regions_negative_fixture():
+    """A deliberately unannotated kernel spec must produce a finding —
+    the gate proving the lint still fires."""
+    from bitcoinconsensus_tpu.analysis import host_lint
+    from bitcoinconsensus_tpu.analysis.registry import KernelSpec
+
+    def bare(a, b):
+        return a * b + a  # no region scope anywhere
+
+    spec = KernelSpec(
+        name="toy.unannotated",
+        build=lambda B: (
+            bare,
+            (jax.ShapeDtypeStruct((L.NLIMB, B), jnp.int32),) * 2,
+        ),
+    )
+    findings = host_lint.lint_kernel_regions(specs=[spec])
+    assert len(findings) == 1
+    f = findings[0]
+    assert f.rule == "region" and "toy.unannotated" in f.path
+    assert "named_region" in f.msg
+
+
+def test_lint_kernel_regions_untraceable_is_a_finding():
+    from bitcoinconsensus_tpu.analysis import host_lint
+    from bitcoinconsensus_tpu.analysis.registry import KernelSpec
+
+    def boom(_B):
+        raise RuntimeError("cannot build")
+
+    spec = KernelSpec(name="toy.broken", build=boom)
+    findings = host_lint.lint_kernel_regions(specs=[spec])
+    assert len(findings) == 1 and "trace failed" in findings[0].msg
+
+
+# ---------------------------------------------------------------------------
+# The locked xla_trace adapter still produces a profiler capture dir.
+
+
+def test_xla_trace_adapter_writes_capture(tmp_path, capsys):
+    from bitcoinconsensus_tpu.utils.profiling import xla_trace
+
+    a = jnp.ones((L.NLIMB, 4), jnp.int32)
+    fn = jax.jit(L.fe_mul)
+    np.asarray(fn(a, a))  # compile outside the session
+    with xla_trace(str(tmp_path)):
+        np.asarray(fn(a, a))
+    assert f"xla trace written to {tmp_path}" in capsys.readouterr().out
+    produced = [
+        p for _root, _d, files in os.walk(tmp_path) for p in files
+    ]
+    assert produced, "profiler session left no capture files"
