@@ -315,6 +315,92 @@ def lint_consensus_host(repo_root: str) -> List[LintFinding]:
     return findings
 
 
+# -- scalar-recoder schedule coverage (PR 19) ----------------------------
+#
+# Any digit-recoding / scalar-split function in ops/ or crypto/glv.py
+# must be registered with the scalar-schedule prover
+# (analysis/scalar_check.REGISTERED_RECODERS), mirroring the PR 17
+# region-coverage rule: a new recoder landing without a certificate
+# would silently reopen the window-order / carry-fold hole the prover
+# closed. Detection is AST-only: a function counts as a recoder when
+# its name carries a scalar-decomposition hint, or its body extracts
+# windowed digits — a `(x >> amt) & mask` where the shift amount is not
+# a plain integer constant (fixed-shift carry propagation in the field
+# ops is NOT a recoder; variable-shift extraction is).
+
+SCALAR_RECODER_NAME_HINTS = (
+    "digit", "window", "recode", "split_lambda", "scalar_bits",
+    "to_limbs", "limbs_to",
+)
+
+
+def _is_var_shift_extract(node: ast.AST) -> bool:
+    """`(expr >> amt) & mask` with a non-constant shift amount."""
+    if not (isinstance(node, ast.BinOp) and isinstance(node.op, ast.BitAnd)):
+        return False
+    for side in (node.left, node.right):
+        if (isinstance(side, ast.BinOp)
+                and isinstance(side.op, ast.RShift)
+                and not isinstance(side.right, ast.Constant)):
+            return True
+    return False
+
+
+def scalar_recoder_functions(paths: Sequence[str]):
+    """All (path, line, name) recoder-shaped functions under `paths`."""
+    hits = []
+    for root in paths:
+        files = _iter_py(root) if os.path.isdir(root) else [root]
+        for path in files:
+            with open(path, "r", encoding="utf-8") as fh:
+                try:
+                    tree = ast.parse(fh.read(), filename=path)
+                except SyntaxError:
+                    continue  # lint_paths reports syntax errors
+            for node in ast.walk(tree):
+                if not isinstance(node, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef)):
+                    continue
+                name = node.name.lower()
+                named = any(h in name for h in SCALAR_RECODER_NAME_HINTS)
+                extracts = any(_is_var_shift_extract(n)
+                               for n in ast.walk(node))
+                if named or extracts:
+                    hits.append((path, node.lineno, node.name))
+    return hits
+
+
+def lint_scalar_recoders(
+    repo_root: str = None,
+    paths: Sequence[str] = None,
+    registered=None,
+) -> List[LintFinding]:
+    """One finding per recoder-shaped function not registered with the
+    scalar-schedule prover.
+
+    `paths` / `registered` override the defaults (the negative-fixture
+    tests feed a deliberately unregistered toy recoder through the same
+    gate)."""
+    if paths is None:
+        pkg = os.path.join(repo_root, "bitcoinconsensus_tpu")
+        paths = [os.path.join(pkg, "ops"),
+                 os.path.join(pkg, "crypto", "glv.py")]
+    if registered is None:
+        from . import scalar_check
+        registered = scalar_check.REGISTERED_RECODERS
+    findings: List[LintFinding] = []
+    for path, line, name in scalar_recoder_functions(paths):
+        if name not in registered:
+            findings.append(LintFinding(
+                path, line, "scalar-coverage",
+                f"`{name}` looks like a digit recoder / scalar split but "
+                "is not registered with the scalar-schedule prover — add "
+                "it to analysis/scalar_check.REGISTERED_RECODERS mapped "
+                "to the target that certifies it (and extend the prover "
+                "if no target covers it yet)"))
+    return findings
+
+
 # -- kernel region-annotation coverage (PR 17) ---------------------------
 #
 # Not an AST rule: this one traces. Every kernel registered in

@@ -58,6 +58,7 @@ do not allow in consensus kernels.
 
 from __future__ import annotations
 
+import math
 from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
@@ -138,10 +139,11 @@ class AbstractArray:
     """
 
     __slots__ = ("shape", "dtype", "cells", "nz0", "uni0", "dist0",
-                 "exactf", "fwhy", "poly")
+                 "exactf", "fwhy", "poly", "cong")
 
     def __init__(self, shape, dtype, cells, nz0=False, uni0=False,
-                 exactf=False, dist0=False, poly=None, fwhy=None):
+                 exactf=False, dist0=False, poly=None, fwhy=None,
+                 cong=None):
         self.shape = tuple(shape)
         self.dtype = np.dtype(dtype)
         self.cells = cells  # list[r0] of list[r1] of (lo, hi)
@@ -155,6 +157,15 @@ class AbstractArray:
         # decomposition over interval atoms; used to recover correlations
         # interval arithmetic loses (the Karatsuba z1 = S - z0 - z2).
         self.poly = poly
+        # Optional congruence facts (see _cong_transfer): a list of
+        # per-axis-0-row facts, each None or a pair (m, r) meaning every
+        # element of that row satisfies x ≡ r (mod m); m == 0 means the
+        # row is EXACTLY r (the zero modulus is the whole-integer-kills-
+        # everything convention: gcd(0, m) == m makes the join uniform).
+        # Length is 1 (a fact uniform over all rows) or shape[0]. None
+        # means no fact — always sound to drop, which is what widening
+        # and every unsupported transfer do.
+        self.cong = cong
 
     @property
     def r0(self) -> int:
@@ -290,8 +301,16 @@ def from_concrete(arr) -> AbstractArray:
         row_lo, row_hi = flat.min(axis=1), flat.max(axis=1)
         dist0 = bool(np.all(row_lo == row_hi)
                      and len(np.unique(row_lo)) == a.shape[0])
-    return mk(a.shape, a.dtype, cells, uni0=uni0, exactf=exactf,
-              dist0=dist0)
+    av = mk(a.shape, a.dtype, cells, uni0=uni0, exactf=exactf,
+            dist0=dist0)
+    if kind != "float":
+        # Congruence seeding: a constant row is exactly its value (m=0).
+        rows = [((0, row[0][0]) if all(lo == hi and lo == row[0][0]
+                                       for lo, hi in row) else None)
+                for row in cells]
+        if any(f is not None for f in rows):
+            av.cong = rows
+    return av
 
 
 @dataclass
@@ -323,6 +342,11 @@ class Report:
     # bound actually checked against 2^24. This is the machine-checkable
     # per-value bound trace the report JSON exports.
     exactness: List[dict] = field(default_factory=list)
+    # Congruence facts proven for each kernel output: one list per
+    # output, one entry per axis-0 row, each None or (m, r) meaning
+    # every element of that row is ≡ r (mod m) (m == 0: exactly r).
+    out_cong: List[List[Optional[Tuple[int, int]]]] = field(
+        default_factory=list)
     # Pallas-layer facts (analysis/pallas_check.py): peak VMEM live set
     # of the kernel (blocks + scratch + intermediates) and the grid shape.
     vmem_peak_bytes: Optional[int] = None
@@ -350,6 +374,12 @@ class Report:
         }
         if self.exactness:
             d["exactness"] = self.exactness
+        if any(any(f is not None for f in rows) for rows in self.out_cong):
+            d["out_cong"] = [
+                [None if f is None else [int(f[0]), int(f[1])]
+                 for f in rows]
+                for rows in self.out_cong
+            ]
         if self.vmem_peak_bytes is not None:
             d["vmem_peak_bytes"] = int(self.vmem_peak_bytes)
         if self.grid is not None:
@@ -517,11 +547,18 @@ def join_values(a: AbstractArray, b: AbstractArray) -> AbstractArray:
         [_hull(a.cell(i, j), b.cell(i, j)) for j in range(r1)]
         for i in range(r0)
     ]
-    return AbstractArray(
+    out = AbstractArray(
         a.shape, a.dtype, _collapse_if_uniform(cells),
         nz0=a.nz0 and b.nz0, uni0=a.uni0 and b.uni0,
         exactf=a.exactf and b.exactf,
     )
+    if a.cong is not None and b.cong is not None:
+        n = max(len(a.cong), len(b.cong))
+        ra, rb = _cong_expand(a.cong, n), _cong_expand(b.cong, n)
+        rows = [_cong_join(fa, fb) for fa, fb in zip(ra, rb, strict=True)]
+        if any(f is not None for f in rows):
+            out.cong = rows
+    return out
 
 
 # ---------------------------------------------------------------------------
@@ -1852,6 +1889,7 @@ class _Interp:
                     outs = [top(v.aval.shape, v.aval.dtype)
                             for v in eqn.outvars]
             _poly_transfer(eqn, ins, outs)
+            _cong_transfer(eqn, ins, outs)
             self._float_post(name, ew, ins, outs)
             for var, o in zip(eqn.outvars, outs, strict=True):
                 if type(var).__name__ != "DropVar":
@@ -1908,32 +1946,40 @@ class _Interp:
 # ---------------------------------------------------------------------------
 # Public API.
 
-def _abstract_inputs(closed, in_bounds):
+def _abstract_inputs(closed, in_bounds, in_cong=None):
     """Build input AbstractArrays for a closed jaxpr. in_bounds maps the
     flat input position to either None (full lane range), a (lo, hi)
-    tuple, or a per-axis0-row list of (lo, hi)."""
+    tuple, or a per-axis0-row list of (lo, hi). in_cong maps the flat
+    input position to a congruence fact: an (m, r) pair (uniform) or a
+    per-axis0-row list of (m, r) / None."""
     avs = []
     for i, var in enumerate(closed.jaxpr.invars):
         aval = var.aval
         spec = in_bounds.get(i) if in_bounds else None
         if spec is None:
-            avs.append(full_range(aval.shape, aval.dtype))
+            av = full_range(aval.shape, aval.dtype)
         elif isinstance(spec, tuple):
-            avs.append(mk(aval.shape, aval.dtype, [[spec]]))
+            av = mk(aval.shape, aval.dtype, [[spec]])
         else:
             cells = [[(int(lo), int(hi))] for lo, hi in spec]
-            avs.append(mk(aval.shape, aval.dtype, cells))
+            av = mk(aval.shape, aval.dtype, cells)
+        cspec = in_cong.get(i) if in_cong else None
+        if cspec is not None:
+            rows = [cspec] if isinstance(cspec, tuple) else list(cspec)
+            av.cong = [None if f is None else _cong_norm(f[0], f[1])
+                       for f in rows]
+        avs.append(av)
     return avs
 
 
 def analyze_closed(closed, name: str, in_bounds=None,
-                   out_within=None) -> Report:
+                   out_within=None, in_cong=None) -> Report:
     """Run both passes (interval prover + determinism/allowlist gate) over
     a ClosedJaxpr. Returns a Report; report.ok is the gate."""
     report = Report(name=name)
     ctx = _Ctx(report)
     interp = _Interp(ctx)
-    args = _abstract_inputs(closed, in_bounds)
+    args = _abstract_inputs(closed, in_bounds, in_cong=in_cong)
     try:
         outs = interp.eval_closed(closed, args, name)
     except Exception as e:
@@ -1950,6 +1996,10 @@ def analyze_closed(closed, name: str, in_bounds=None,
                 f"output{why}",
             )
         report.out_bounds.append(o.rows0() if o.shape else [o.joined()])
+        n_rows = o.shape[0] if o.shape else 1
+        report.out_cong.append(
+            _cong_expand(o.cong, n_rows) if o.cong is not None
+            and n_rows <= ROW_CAP else [None] * min(n_rows, ROW_CAP))
         if out_within is not None and i < len(out_within) \
                 and out_within[i] is not None:
             hand = out_within[i]
@@ -1977,12 +2027,12 @@ def analyze_closed(closed, name: str, in_bounds=None,
 
 
 def analyze(fn, args, name: str, in_bounds=None, out_within=None,
-            static_argnums=()) -> Report:
+            static_argnums=(), in_cong=None) -> Report:
     """Trace `fn` at example `args` (concrete or ShapeDtypeStruct) and
     analyze the resulting jaxpr."""
     closed = jax.make_jaxpr(fn, static_argnums=static_argnums)(*args)
     return analyze_closed(closed, name, in_bounds=in_bounds,
-                          out_within=out_within)
+                          out_within=out_within, in_cong=in_cong)
 
 
 # ---------------------------------------------------------------------------
@@ -2346,3 +2396,321 @@ def _poly_transfer(eqn, ins, outs):
         if not dominated:
             out.poly = p
             _refine_with_poly(out)
+
+
+# ---------------------------------------------------------------------------
+# Congruence refinement.
+#
+# Alongside each interval cell grid, an AbstractArray may carry per-row
+# congruence facts x ≡ r (mod m) (m == 0: exactly r). The facts flow
+# through the integer ops the scalar-recoding pipeline is built from —
+# add/sub/neg, mul, shifts by exact amounts, masking, or-of-disjoint-
+# support, reductions, and the structural ops — with gcd-based joins, so
+# the analyzer can certify place-value structure (a weighted bit plane
+# b_i * 2^i is ≡ 0 mod 2^i; a partial recombination sum of planes i >= t
+# is ≡ 0 mod 2^t) that pure intervals cannot express. Any unsupported
+# op drops the fact (always sound); widening constructs fresh
+# AbstractArrays and so drops facts automatically. The exact-recombination
+# theorems of analysis/scalar_check.py use this domain for the modular
+# layer of the digit-recoding certificates.
+
+def _cong_norm(m: int, r: int):
+    """Normalize a fact: m >= 0; m == 1 carries no information (None);
+    m == 0 means exactly r; otherwise reduce r mod m."""
+    m = abs(int(m))
+    r = int(r)
+    if m == 1:
+        return None
+    if m == 0:
+        return (0, r)
+    return (m, r % m)
+
+
+def _cong_join(fa, fb):
+    """Weakest fact implied by both: gcd(m1, m2, r1 - r2)."""
+    if fa is None or fb is None:
+        return None
+    (m1, r1), (m2, r2) = fa, fb
+    return _cong_norm(math.gcd(math.gcd(m1, m2), abs(r1 - r2)), r1)
+
+
+def _cong_expand(rows, n: int):
+    """Expand a fact list to exactly n per-row entries (len-1 = uniform)."""
+    if rows is None:
+        return [None] * n
+    if len(rows) == n:
+        return list(rows)
+    if len(rows) == 1:
+        return [rows[0]] * n
+    return [None] * n
+
+
+def _cong_add(fa, fb, sign=1):
+    if fa is None or fb is None:
+        return None
+    (m1, r1), (m2, r2) = fa, fb
+    return _cong_norm(math.gcd(m1, m2), r1 + sign * r2)
+
+
+def _cong_mul(fa, fb):
+    """(r1 + a·m1)(r2 + b·m2) ≡ r1·r2 mod gcd(m1·m2, m1·r2, m2·r1).
+    A factless operand is (1, 0) — any integer ≡ 0 (mod 1) — so a
+    product with an exactly-known factor still yields x·c ≡ 0 (mod c)."""
+    if fa is None:
+        fa = (1, 0)
+    if fb is None:
+        fb = (1, 0)
+    (m1, r1), (m2, r2) = fa, fb
+    return _cong_norm(
+        math.gcd(math.gcd(m1 * m2, abs(m1 * r2)), abs(m2 * r1)), r1 * r2)
+
+
+def _cong_exact_rows(av: AbstractArray, n: int):
+    """Per-row exactly-known values (from facts with m == 0), else None."""
+    rows = _cong_expand(av.cong, n)
+    return [r[1] if (r is not None and r[0] == 0) else None for r in rows]
+
+
+def _cong_rows_for(av: AbstractArray, out: AbstractArray, n: int):
+    """Operand facts aligned to the result's n axis-0 rows under
+    elementwise broadcasting: a scalar / size-1-leading operand is
+    uniform; a same-leading-length operand maps row to row."""
+    if av.cong is None:
+        return [None] * n
+    if not av.shape or av.shape[0] == 1:
+        return [av.cong[0]] * n
+    if out.shape and av.shape[0] == out.shape[0] and len(av.cong) in (1, n):
+        return _cong_expand(av.cong, n)
+    if len(av.cong) == 1:
+        return [av.cong[0]] * n
+    return [None] * n
+
+
+def _row_hull(av: AbstractArray, i: int):
+    lo = min(av.cell(i, j)[0] for j in range(max(av.r1, 1)))
+    hi = max(av.cell(i, j)[1] for j in range(max(av.r1, 1)))
+    return lo, hi
+
+
+def _cong_transfer(eqn, ins, outs):
+    """Attach congruence facts to the output of supported integer ops.
+    Pure precision layer: every unsupported case leaves cong=None."""
+    if len(outs) != 1:
+        return
+    out = outs[0]
+    if _dkind(out.dtype)[0] not in ("int", "uint", "bool"):
+        return
+    n = out.shape[0] if out.shape else 1
+    if n == 0 or n > ROW_CAP:
+        n = 1 if not out.shape else n
+        if n > ROW_CAP:
+            return
+    name = eqn.primitive.name
+    rows = None
+    try:
+        if name in ("add", "sub"):
+            ra = _cong_rows_for(ins[0], out, n)
+            rb = _cong_rows_for(ins[1], out, n)
+            sign = 1 if name == "add" else -1
+            rows = [_cong_add(a, b, sign) for a, b in zip(ra, rb)]
+        elif name == "neg":
+            ra = _cong_rows_for(ins[0], out, n)
+            rows = [None if f is None else _cong_norm(f[0], -f[1])
+                    for f in ra]
+        elif name == "mul":
+            ra = _cong_rows_for(ins[0], out, n)
+            rb = _cong_rows_for(ins[1], out, n)
+            rows = [None if (a is None and b is None) else _cong_mul(a, b)
+                    for a, b in zip(ra, rb)]
+        elif name == "shift_left":
+            ra = _cong_rows_for(ins[0], out, n)
+            sh = _cong_exact_rows(ins[1], n) if ins[1].cong is not None \
+                else [None] * n
+            rows = [
+                None if (s is None or not 0 <= s < 64)
+                else _cong_mul(a, (0, 1 << s))
+                for a, s in zip(ra, sh)
+            ]
+        elif name in ("shift_right_logical", "shift_right_arithmetic"):
+            # x >> c with 2^c | m and 2^c | r and x >= 0: then 2^c | x,
+            # the shift is an exact division, and x/2^c ≡ r/2^c (m/2^c).
+            ra = _cong_rows_for(ins[0], out, n)
+            sh = _cong_exact_rows(ins[1], n) if ins[1].cong is not None \
+                else [None] * n
+            rows = []
+            for i, (a, s) in enumerate(zip(ra, sh)):
+                f = None
+                if a is not None and s is not None and 0 <= s < 64:
+                    m, r = a
+                    lo, _ = _row_hull(ins[0], i if ins[0].r0 > 1 else 0)
+                    if lo >= 0 and m % (1 << s) == 0 and r % (1 << s) == 0:
+                        f = _cong_norm(m >> s, r >> s)
+                rows.append(f)
+        elif name == "and":
+            # x & (2^t - 1) on x >= 0 is x mod 2^t; with 2^t | m that
+            # residue is exactly r mod 2^t.
+            for xi, mi in ((0, 1), (1, 0)):
+                mask_rows = _cong_exact_rows(ins[mi], n) \
+                    if ins[mi].cong is not None else [None] * n
+                xa = _cong_rows_for(ins[xi], out, n)
+                got = []
+                for i, (f, msk) in enumerate(zip(xa, mask_rows)):
+                    g = None
+                    if (f is not None and msk is not None and msk >= 0
+                            and (msk & (msk + 1)) == 0):
+                        t = msk.bit_length()
+                        m, r = f
+                        lo, _ = _row_hull(ins[xi],
+                                          i if ins[xi].r0 > 1 else 0)
+                        if lo >= 0 and (m % (1 << t) == 0 or m == 0):
+                            g = (0, r % (1 << t))
+                    got.append(g)
+                if any(g is not None for g in got):
+                    rows = got
+                    break
+        elif name == "or":
+            # Disjoint-support or is add: y's low t bits provably zero
+            # (2^t | m and 2^t | r) and 0 <= x < 2^t (cells), or
+            # symmetrically.
+            for xi, yi in ((0, 1), (1, 0)):
+                xa = _cong_rows_for(ins[xi], out, n)
+                ya = _cong_rows_for(ins[yi], out, n)
+                got = []
+                for i, (fx, fy) in enumerate(zip(xa, ya)):
+                    g = None
+                    if fy is not None:
+                        my, ry = fy
+                        lo, hi = _row_hull(ins[xi],
+                                           i if ins[xi].r0 > 1 else 0)
+                        if lo >= 0 and hi >= 0:
+                            t = hi.bit_length()
+                            if (my % (1 << t) == 0 or my == 0) \
+                                    and ry % (1 << t) == 0 \
+                                    and (my != 0 or ry % (1 << t) == 0):
+                                g = _cong_add(fx if fx is not None
+                                              else (1, 0), fy)
+                    got.append(g)
+                if any(g is not None for g in got):
+                    rows = got
+                    break
+        elif name == "convert_element_type":
+            # Safe only when the conversion cannot wrap: the input
+            # interval must fit the target lane.
+            if _dkind(ins[0].dtype)[0] in ("int", "uint", "bool"):
+                kind, bits = _dkind(out.dtype)
+                lo_l = -(1 << (bits - 1)) if kind == "int" else 0
+                hi_l = (1 << (bits - 1)) - 1 if kind == "int" \
+                    else (1 << bits) - 1
+                glo, ghi = ins[0].joined()
+                if lo_l <= glo and ghi <= hi_l:
+                    rows = _cong_rows_for(ins[0], out, n)
+        elif name == "broadcast_in_dim":
+            src = ins[0]
+            if src.cong is not None:
+                bdims = eqn.params["broadcast_dimensions"]
+                if not src.shape or src.shape[0] == 1 or len(src.cong) == 1:
+                    rows = [src.cong[0]] * n
+                elif bdims and bdims[0] == 0 and out.shape \
+                        and src.shape[0] == out.shape[0]:
+                    rows = _cong_expand(src.cong, n)
+                else:
+                    # Every output element is some input element, so the
+                    # join over all source rows is always sound.
+                    acc = src.cong[0]
+                    for f in src.cong[1:]:
+                        acc = _cong_join(acc, f)
+                    if acc is not None:
+                        rows = [acc] * n
+        elif name in ("reshape", "squeeze", "transpose", "rev",
+                      "copy", "stop_gradient"):
+            # Layout changes permute/forward elements: a uniform fact
+            # survives as-is, a per-row fact survives as the rows' join.
+            if ins[0].cong is not None:
+                acc = ins[0].cong[0]
+                for f in ins[0].cong[1:]:
+                    acc = _cong_join(acc, f)
+                if acc is not None:
+                    rows = [acc] * n
+        elif name == "slice":
+            src = ins[0]
+            if src.cong is not None and out.shape:
+                if len(src.cong) == 1:
+                    rows = [src.cong[0]] * n
+                else:
+                    starts = eqn.params["start_indices"]
+                    strides = eqn.params.get("strides") \
+                        or (1,) * len(starts)
+                    s0, st0 = starts[0], strides[0]
+                    full = _cong_expand(src.cong, src.shape[0])
+                    rows = [full[s0 + k * st0] for k in range(out.shape[0])]
+        elif name == "concatenate":
+            if eqn.params["dimension"] == 0 and out.shape \
+                    and out.shape[0] <= ROW_CAP:
+                rows = []
+                for o in ins:
+                    rows.extend(_cong_expand(o.cong, o.shape[0]))
+        elif name == "reduce_sum":
+            axes = eqn.params["axes"]
+            src = ins[0]
+            if src.cong is not None and src.shape:
+                k_other = 1
+                for ax in axes:
+                    if ax != 0:
+                        k_other *= src.shape[ax]
+                full = _cong_expand(src.cong, src.shape[0])
+                # each row's sum: k_other elements per row index ≡ k·r
+                per_row = [None if f is None
+                           else _cong_mul(f, (0, k_other))
+                           for f in full]
+                if 0 in axes:
+                    acc = per_row[0]
+                    for f in per_row[1:]:
+                        acc = _cong_add(acc, f)
+                    rows = [acc] * n
+                elif out.shape and out.shape[0] == src.shape[0]:
+                    rows = _cong_expand(per_row, n)
+    except Exception:
+        rows = None
+    if rows is not None and any(f is not None for f in rows):
+        if len(rows) not in (1, n):
+            return
+        out.cong = rows
+        _refine_with_cong(out)
+
+
+def _refine_with_cong(av: AbstractArray):
+    """Tighten interval cells to the nearest values satisfying the row's
+    congruence fact (both layers are sound, so the intersection is)."""
+    if av.cong is None:
+        return
+    n = av.shape[0] if av.shape else 1
+    if av.shape and (n == 0 or n > ROW_CAP):
+        return
+    facts = _cong_expand(av.cong, max(av.r0, 1))
+    if len(facts) != av.r0:
+        return
+    cells = []
+    changed = False
+    for i, row in enumerate(av.cells):
+        f = facts[i]
+        if f is None:
+            cells.append(list(row))
+            continue
+        m, r = f
+        new_row = []
+        for lo, hi in row:
+            if m == 0:
+                if lo <= r <= hi:
+                    nlo = nhi = r
+                else:
+                    nlo, nhi = lo, hi  # defensive; keep sound cells
+            else:
+                nlo = lo + ((r - lo) % m)
+                nhi = hi - ((hi - r) % m)
+                if nlo > nhi:
+                    nlo, nhi = lo, hi
+            changed = changed or (nlo, nhi) != (lo, hi)
+            new_row.append((nlo, nhi))
+        cells.append(new_row)
+    if changed:
+        av.cells = _collapse_if_uniform(cells)

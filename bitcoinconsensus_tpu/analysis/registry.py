@@ -254,6 +254,59 @@ def _pallas_verify_build():
                 _flags(B), _bools(B))
 
 
+@dataclass
+class ScheduleSpec:
+    """A scalar-schedule prover target (analysis/scalar_check.py): digit
+    recoders, the GLV split, and the window ladders.  `certify` returns a
+    CertResult whose status is THEOREM / VACUOUS / FAIL — fail-closed, the
+    same discipline as the interval kernels above."""
+
+    name: str
+    heavy: bool = False  # heavy: eager ledger walk (~1-2 min on CPU)
+    note: str = ""
+
+    def certify(self, quick: bool = False):
+        from . import scalar_check
+        return scalar_check.certify(self.name, quick=quick)
+
+
+def _schedule_specs() -> List[ScheduleSpec]:
+    return [
+        ScheduleSpec("scalar._digits",
+                     note="4-bit window recoding: exact bit-slice theorem"),
+        ScheduleSpec("scalar._digits128",
+                     note="4-bit recoding of GLV halves + congruence planes"),
+        ScheduleSpec("scalar.bytes_to_limbs",
+                     note="byte->limb packing, 32B/20L and 16B/10L"),
+        ScheduleSpec("sha256.bytes_from_words",
+                     note="digest word->byte unpack, big-endian slices"),
+        ScheduleSpec("scalar._signed_digits128",
+                     note="signed window recoder: exhaustive carry automaton"),
+        ScheduleSpec("glv.split_lambda",
+                     note="lattice constants + |k1|,|k2| < 2^128 certificate"),
+        ScheduleSpec("curve.double_scalar_mult", heavy=True,
+                     note="Strauss ladder weight ledger + differential"),
+        ScheduleSpec("curve.double_scalar_mult_glv", heavy=True,
+                     note="GLV ladder weight ledger + differential"),
+        ScheduleSpec("pallas.kernel_schedule", heavy=True,
+                     note="Mosaic kernel: table object-flow + signed ledger"),
+    ]
+
+
+def all_schedules(include_heavy: bool = True) -> List[ScheduleSpec]:
+    specs = _schedule_specs()
+    if not include_heavy:
+        specs = [s for s in specs if not s.heavy]
+    return specs
+
+
+def get_schedule(name: str) -> ScheduleSpec:
+    for s in _schedule_specs():
+        if s.name == name:
+            return s
+    raise KeyError(name)
+
+
 def all_kernels(include_heavy: bool = True) -> List[KernelSpec]:
     specs = _specs()
     if not include_heavy:

@@ -15,13 +15,37 @@ from __future__ import annotations
 
 from typing import Tuple
 
+from ..obs import counter
 from ..ops.curve import LAMBDA
 from .secp_host import N
 
-__all__ = ["split_lambda", "LAMBDA"]
+__all__ = ["split_lambda", "SplitRangeError", "LAMBDA"]
 
 _B1 = -0xE4437ED6010E88286F547FA90ABFE4C3
 _B2 = 0x3086D221A7D46BCDE86C90E49284EB15
+
+_SPLIT_RANGE = counter(
+    "consensus_glv_split_range_total",
+    "GLV split produced a half >= 2^128 — the lattice certificate "
+    "(analysis/scalar_check.py glv.split_lambda) is violated at runtime",
+    ("half",))
+
+
+class SplitRangeError(ValueError):
+    """A GLV half escaped the proven |k_i| < 2^128 bound.
+
+    The scalar-schedule prover certifies this cannot happen for the
+    shipped constants, so reaching it means the constants (or the
+    arithmetic) were corrupted in-process.  Unlike the bare ``assert``
+    this replaces, the check survives ``python -O`` — a wrong-size half
+    silently truncates in the 128-bit device decomposition, which is a
+    consensus fault, never an optimization."""
+
+    def __init__(self, k: int, a1: int, a2: int):
+        self.k, self.a1, self.a2 = k, a1, a2
+        super().__init__(
+            f"GLV split out of range: k={k:#x} -> |k1|={a1:#x}, "
+            f"|k2|={a2:#x}; proven bound is 2^128")
 
 
 def split_lambda(k: int) -> Tuple[int, int, int, int]:
@@ -38,5 +62,10 @@ def split_lambda(k: int) -> Tuple[int, int, int, int]:
     neg2 = k2 > N - k2
     a1 = N - k1 if neg1 else k1
     a2 = N - k2 if neg2 else k2
-    assert a1 < 1 << 128 and a2 < 1 << 128, (k, a1, a2)
+    if a1 >= 1 << 128 or a2 >= 1 << 128:
+        if a1 >= 1 << 128:
+            _SPLIT_RANGE.inc(half="k1")
+        if a2 >= 1 << 128:
+            _SPLIT_RANGE.inc(half="k2")
+        raise SplitRangeError(k, a1, a2)
     return a1, int(neg1), a2, int(neg2)

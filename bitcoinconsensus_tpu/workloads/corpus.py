@@ -72,7 +72,7 @@ from ..utils.hashes import hash160, sha256, tagged_hash
 __all__ = ["SHAPES", "CorpusCase", "build_corpus", "shape_batch"]
 
 # Corpus taxonomy (README "Adversarial workloads & gauntlet"). The first
-# four are the per-shape bench/baseline axes; the last two are
+# four are the per-shape bench/baseline axes; the rest are
 # verdict-pinning shapes (cheap, correctness-only).
 SHAPES = (
     "multisig_fanout",
@@ -81,6 +81,7 @@ SHAPES = (
     "taproot_annex",
     "sig_malleation",
     "boundary_flags",
+    "scalar_edge",
 )
 
 AMOUNT = COIN // 100
@@ -485,6 +486,114 @@ def _cases_malleation_and_flags() -> List[CorpusCase]:
     ]
 
 
+# --------------------------------------------------------------------------
+# scalar_edge — verifications whose ECDSA scalars hit the GLV/recoder
+# boundaries the scalar-schedule prover certifies (analysis/scalar_check):
+# u2 = r·s⁻¹ mod n is what `split_lambda` decomposes and the windowed
+# recoders digest, so each case *constructs* a signature with a pinned u2.
+#
+# Construction (bare OP_CHECKSIG spk, so the legacy sighash z is
+# key-independent): pick a nonce k, r = x(k·G); set s = r·t⁻¹ so that
+# u2 = r·s⁻¹ = t exactly; then the verification equation
+# u1·G + u2·P = k·G fixes the secret key sk = (k − u1)·t⁻¹ mod n.
+# Flags are VERIFY_P2SH only (no LOW_S: s is whatever t demands).
+# --------------------------------------------------------------------------
+
+def _u2_pinned_spend(tag: str, t: int, u1_one: bool = False,
+                     break_sig: bool = False) -> Tuple[Tx, bytes]:
+    """Spend of a bare OP_CHECKSIG output whose verification scalar
+    u2 ≡ t (mod n) — or u1 == 1 when `u1_one` (t is then implied)."""
+    spk = bytes([OP_CHECKSIG])
+    tx = _spend_tx(tag)
+    z = int.from_bytes(legacy_sighash(spk, tx, 0, SIGHASH_ALL), "big") % H.N
+    ctr = 0
+    while True:
+        k = _sk(f"{tag}/nonce/{ctr}")
+        ctr += 1
+        raff = H.G.mul(k).to_affine()
+        r = raff[0] % H.N
+        if r == 0:
+            continue
+        if u1_one:
+            s = z  # u1 = z·s⁻¹ = 1
+            t = r * pow(s, H.N - 2, H.N) % H.N
+        else:
+            s = r * pow(t, H.N - 2, H.N) % H.N
+        if s == 0 or t == 0:
+            continue
+        u1 = z * pow(s, H.N - 2, H.N) % H.N
+        sk = (k - u1) * pow(t, H.N - 2, H.N) % H.N
+        if sk == 0:
+            continue
+        break
+    pub = H.pubkey_create(sk)
+    if break_sig:
+        s = s + 1 if s + 1 < H.N else s - 1
+    body = H._der_encode_int(r) + H._der_encode_int(s)
+    sig = b"\x30" + bytes([len(body)]) + body + bytes([SIGHASH_ALL])
+    tx.vin[0].script_sig = push_data(sig) + push_data(pub)
+    tx.invalidate_caches()
+    return tx, spk
+
+
+def _cases_scalar_edge() -> List[CorpusCase]:
+    from ..crypto.glv import LAMBDA  # local: pulls in ops.curve (jax)
+
+    # Every signed digit at the minimum -16 (the maximal 25-long carry
+    # chain): window 0 holds 16, windows 1..24 hold 15 (+1 carry-in),
+    # and the top window absorbs the final carry at its proven cap of 7.
+    max_digits = 16 + 15 * sum(32 ** w for w in range(1, 25)) + 6 * 32 ** 25
+    targets = [
+        ("scalar-u2-one", 1,
+         "u2 pinned to 1: the minimal nonzero scalar through the "
+         "GLV split and both recoders"),
+        ("scalar-u2-n-minus-1", H.N - 1,
+         "u2 pinned to n-1: negation-heavy split, maximal reduction"),
+        ("scalar-u2-lambda", LAMBDA,
+         "u2 pinned to the endomorphism eigenvalue lambda: the split "
+         "degenerates to (0, 1) up to sign"),
+        ("scalar-u2-lambda-plus-1", (LAMBDA + 1) % H.N,
+         "u2 pinned one past lambda: smallest perturbation off the "
+         "lattice eigenvector"),
+        ("scalar-u2-2p128-minus-1", (1 << 128) - 1,
+         "u2 pinned to 2^128-1: a split half exactly at the proven "
+         "|k_i| < 2^128 boundary when the split passes it through"),
+        ("scalar-u2-2p128", 1 << 128,
+         "u2 pinned to 2^128: first scalar the 128-bit half encoding "
+         "cannot carry verbatim — the lattice must actually reduce"),
+        ("scalar-u2-max-signed-digits", max_digits,
+         "u2 whose signed recoding is all windows at -16 (maximal "
+         "carry chain) with the top window at its carry-free cap of 7"),
+    ]
+    cases = [
+        CorpusCase(
+            name, "scalar_edge", desc,
+            _item(_tx_spk[0], _tx_spk[1], flags=VERIFY_P2SH),
+            True, Error.ERR_OK, ScriptError.OK,
+        )
+        for name, t, desc in targets
+        for _tx_spk in [_u2_pinned_spend(name, t)]
+    ]
+    u1_tx, u1_spk = _u2_pinned_spend("scalar-u1-one", 0, u1_one=True)
+    cases.append(CorpusCase(
+        "scalar-u1-one", "scalar_edge",
+        "u1 pinned to 1: the G-table multiplier at its minimal nonzero "
+        "value",
+        _item(u1_tx, u1_spk, flags=VERIFY_P2SH),
+        True, Error.ERR_OK, ScriptError.OK,
+    ))
+    bad_tx, bad_spk = _u2_pinned_spend("scalar-u2-lambda-bad", LAMBDA,
+                                       break_sig=True)
+    cases.append(CorpusCase(
+        "scalar-u2-lambda-badsig", "scalar_edge",
+        "same lambda-pinned construction with s+1: CHECKSIG pushes "
+        "false and the script fails EVAL_FALSE (no NULLFAIL in flags)",
+        _item(bad_tx, bad_spk, flags=VERIFY_P2SH),
+        False, Error.ERR_SCRIPT, ScriptError.EVAL_FALSE,
+    ))
+    return cases
+
+
 def build_corpus() -> List[CorpusCase]:
     """The full pinned corpus, deterministic (no RNG anywhere above)."""
     return (
@@ -493,6 +602,7 @@ def build_corpus() -> List[CorpusCase]:
         + _cases_max_size()
         + _cases_taproot_annex()
         + _cases_malleation_and_flags()
+        + _cases_scalar_edge()
     )
 
 

@@ -9,6 +9,7 @@ deterministic, and lint the host-side consensus path.
     python scripts/consensus_lint.py --report out.json
     python scripts/consensus_lint.py --negative oob-index-map
     python scripts/consensus_lint.py --exactness --report theorems.json
+    python scripts/consensus_lint.py --schedule --report schedule.json
 
 Exit status 0 iff every kernel proves clean AND the host lint is clean.
 The JSON report carries the derived per-limb output bounds of every
@@ -17,10 +18,11 @@ for kernels with f32 values, the per-value exactness trace — so
 reviewers can diff bounds across PRs (CI uploads it as a build
 artifact).
 
-`--negative NAME` runs one of the deliberately broken toy Pallas
-kernels from `analysis/pallas_check.NEGATIVES` and exits non-zero with
-its diagnostics: the gate proving it still fires. `--negative list`
-lists the available toys.
+`--negative NAME` runs one of the deliberately broken toys — a Pallas
+kernel from `analysis/pallas_check.NEGATIVES` or a scalar schedule from
+`analysis/scalar_check.NEGATIVES` — and exits non-zero with its
+diagnostics: the gate proving it still fires. `--negative list` lists
+the available toys from both families.
 
 `--exactness` is the exact-float theorem leg: for each f32-bearing
 kernel (default: the MXU one-hot fe_mul candidate and the two existing
@@ -30,6 +32,17 @@ integer-valued with magnitude (and accumulated dot/reduce sums)
 <= 2^24 — then requires every `f32-*` negative toy to be REJECTED with
 a `float` violation. Exit 0 iff all theorems hold and all unsound toys
 are rejected; `--report` writes the theorem sections as JSON.
+
+`--schedule` is the scalar-schedule theorem leg: for every target in
+`analysis/registry.all_schedules()` (digit recoders, the GLV lattice
+split, the XLA and Pallas window ladders) it runs the scalar-semantics
+prover (`analysis/scalar_check.py`) and prints THEOREM / VACUOUS /
+FAIL, runs the sound toy-ladder self-test (the checker must PASS it),
+then requires every `scalar-*` negative toy to be REJECTED with a
+`schedule` violation. Exit 0 iff every target is THEOREM, the
+self-test passes, and all unsound toys are rejected; `--report` writes
+the certificates as JSON (CI uploads it as the schedule-certificates
+artifact).
 """
 
 from __future__ import annotations
@@ -63,17 +76,25 @@ def main() -> int:
                     help="exact-float theorem leg: prove every f32 value "
                          "in the one-hot MXU kernels integer-exact and "
                          "reject all f32-* negative toys")
+    ap.add_argument("--schedule", action="store_true",
+                    help="scalar-schedule theorem leg: certify the digit "
+                         "recoders, GLV split, and window ladders, and "
+                         "reject all scalar-* negative toys")
     args = ap.parse_args()
 
     from bitcoinconsensus_tpu.analysis import host_lint, registry
 
     if args.negative:
-        from bitcoinconsensus_tpu.analysis import pallas_check
+        from bitcoinconsensus_tpu.analysis import pallas_check, scalar_check
         if args.negative == "list":
-            for n in sorted(pallas_check.NEGATIVES):
+            for n in sorted(set(pallas_check.NEGATIVES)
+                            | set(scalar_check.NEGATIVES)):
                 print(n)
             return 0
-        rep = pallas_check.analyze_negative(args.negative)
+        if args.negative in scalar_check.NEGATIVES:
+            rep = scalar_check.analyze_negative(args.negative)
+        else:
+            rep = pallas_check.analyze_negative(args.negative)
         print(f"negative toy `{args.negative}`: "
               f"{'FAILED the gate (expected)' if not rep.ok else 'PROVED CLEAN (gate is dead!)'}")
         for v in rep.violations:
@@ -83,6 +104,9 @@ def main() -> int:
 
     if args.exactness:
         return _exactness_leg(args, registry)
+
+    if args.schedule:
+        return _schedule_leg(args, registry)
 
     specs = registry.all_kernels(include_heavy=not args.quick)
     if args.kernel:
@@ -108,6 +132,14 @@ def main() -> int:
     print(f"  {'clean' if not region_findings else f'{len(region_findings)} finding(s)'}")
     host_ok = host_ok and not region_findings
     findings = findings + region_findings
+
+    print("\n== scalar-recoder schedule coverage (ops/ + crypto/glv.py) ==")
+    scalar_findings = host_lint.lint_scalar_recoders(REPO)
+    for f in scalar_findings:
+        print(f"  {f}")
+    print(f"  {'clean' if not scalar_findings else f'{len(scalar_findings)} finding(s)'}")
+    host_ok = host_ok and not scalar_findings
+    findings = findings + scalar_findings
 
     print("\n== kernel interval prover + determinism gate ==")
     all_ok = host_ok
@@ -222,6 +254,67 @@ def _exactness_leg(args, registry) -> int:
         print(f"\nreport written to {args.report}")
 
     print(f"\nexactness theorems: {'OK' if all_ok else 'FAILED'}")
+    return 0 if all_ok else 1
+
+
+def _schedule_leg(args, registry) -> int:
+    from bitcoinconsensus_tpu.analysis import scalar_check
+
+    if args.kernel:
+        specs = [registry.get_schedule(n) for n in sorted(set(args.kernel))]
+    else:
+        specs = registry.all_schedules(include_heavy=not args.quick)
+    sections = []
+    all_ok = True
+
+    print("== scalar-schedule theorems "
+          "(congruence + carry automaton + weight ledger) ==")
+    for spec in specs:
+        t0 = time.time()
+        cert = spec.certify(quick=args.quick)
+        dt = time.time() - t0
+        print(f"  {spec.name:40s} {cert.status}  facts={len(cert.facts)}"
+              f"  ({dt:.1f}s)")
+        for f in cert.failures[:8]:
+            print(f"      {f}")
+        if len(cert.failures) > 8:
+            print(f"      ... {len(cert.failures) - 8} more")
+        d = cert.to_dict()
+        d["seconds"] = round(dt, 2)
+        if spec.note:
+            d["note"] = spec.note
+        sections.append(d)
+        all_ok = all_ok and cert.ok
+
+    print("\n== sound toy schedule must PASS (checker liveness) ==")
+    t0 = time.time()
+    self_cert = scalar_check.toy_ladder_selftest()
+    print(f"  {'toy-ladder-selftest':40s} {self_cert.status}"
+          f"  ({time.time() - t0:.1f}s)")
+    for f in self_cert.failures[:8]:
+        print(f"      {f}")
+    sections.append({"name": "selftest.toy_ladder",
+                     "status": self_cert.status, "ok": self_cert.ok})
+    all_ok = all_ok and self_cert.ok
+
+    print("\n== unsound scalar toys must be rejected ==")
+    for name in sorted(scalar_check.NEGATIVES):
+        rep = scalar_check.analyze_negative(name)
+        rejected = (not rep.ok
+                    and any(v.kind == "schedule" for v in rep.violations))
+        verdict = ("REJECTED (expected)" if rejected
+                   else "NOT REJECTED (gate is dead!)")
+        print(f"  {name:40s} {verdict}")
+        sections.append({"name": f"negative.{name}", "rejected": rejected})
+        all_ok = all_ok and rejected
+
+    if args.report:
+        with open(args.report, "w") as fh:
+            json.dump({"schedule": sections}, fh, indent=2, sort_keys=True,
+                      default=str)
+        print(f"\nreport written to {args.report}")
+
+    print(f"\nschedule theorems: {'OK' if all_ok else 'FAILED'}")
     return 0 if all_ok else 1
 
 

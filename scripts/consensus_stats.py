@@ -130,6 +130,13 @@ REQUIRED_METRICS = [
     "consensus_gauntlet_replay_blocks_total",
     "consensus_gauntlet_fuzz_cases_total",
     "consensus_gauntlet_shape_seconds",
+    # scalar-schedule prover (analysis/scalar_check.py: the fast
+    # certificate set re-proves per run and reports per-target status —
+    # a VACUOUS or FAIL sample here is a gate failure, not telemetry)
+    "consensus_scalar_certificates",
+    # GLV runtime range guard (crypto/glv.py SplitRangeError path;
+    # registered at import, zero in any healthy run)
+    "consensus_glv_split_range_total",
     # device-truth observatory (the workload's capture leg runs the
     # op-walk degradation of the xprof trace on CPU; the same gauges
     # carry real profiler attribution on accelerators)
@@ -333,6 +340,23 @@ def run_mini_workload() -> None:
     assert crep["pinned"], crep["mismatches"]
     frep = run_diff_fuzz(seed=1, n_cases=8)
     assert frep["bit_identical"], frep["divergences"]
+
+    # --- scalar-schedule prover: re-prove the fast certificate set
+    # (digit recoders, byte packers, GLV lattice constants) so the
+    # consensus_scalar_certificates{target,status} family carries a
+    # THEOREM sample per target — a FAIL/VACUOUS status here is a gate
+    # failure. The GLV range guard records explicit zero samples: the
+    # split ran and stayed inside the proven |k_i| < 2^128 bound. ---
+    from bitcoinconsensus_tpu.analysis import scalar_check
+    from bitcoinconsensus_tpu.crypto import glv
+
+    certs = scalar_check.certify_all(quick=True, include_heavy=False)
+    bad = [(c.name, c.status, c.failures) for c in certs if not c.ok]
+    assert not bad, bad
+    for k in (1, glv.LAMBDA, (1 << 128) - 1):
+        glv.split_lambda(k)
+    glv._SPLIT_RANGE.inc(amount=0, half="k1")
+    glv._SPLIT_RANGE.inc(amount=0, half="k2")
 
     # --- device-truth observatory + flight recorder: a tiny capture
     # (the op-walk degradation on CPU containers, the profiler trace on
