@@ -1,0 +1,56 @@
+"""Multi-process serving cell: router, replicas, shared sigstore tier.
+
+The step from "a fast server" to "a service": several
+`IngressServer`+`VerifyServer` replicas (real subprocesses), a
+consistent-hash tenant router with health-driven failover
+(cell/router.py), a known-answer-probing supervisor with bounded
+restart backoff (cell/replica.py), and the persistent sigstore shards
+promoted to a consistent-hash tier with shard handoff on membership
+change (cell/sigtier.py). `ServingCell` (cell/cell.py) wires the four
+together. Chaos-gated by `scripts/consensus_chaos.py --cell`.
+
+Import discipline: `hashring` and `sigtier` are dependency-light
+(stdlib + obs + models.sigstore — no jax anywhere on the chain), so
+subprocess tooling and the kill-9 handoff tests can import them in
+bare children. The router/replica/cell layers pull in the serving
+stack (and with it jax); they are exposed lazily.
+"""
+
+from .hashring import HashRing
+from .sigtier import SigTier, absorb_handoff, iter_shard_records, write_handoff
+
+__all__ = [
+    "CellRouter",
+    "HashRing",
+    "ReplicaProcess",
+    "ReplicaSupervisor",
+    "ServingCell",
+    "SigTier",
+    "StubReplica",
+    "absorb_handoff",
+    "iter_shard_records",
+    "make_probe_items",
+    "probe_replica",
+    "write_handoff",
+]
+
+_LAZY = {
+    "CellRouter": ("router", "CellRouter"),
+    "ReplicaProcess": ("replica", "ReplicaProcess"),
+    "ReplicaSupervisor": ("replica", "ReplicaSupervisor"),
+    "StubReplica": ("replica", "StubReplica"),
+    "make_probe_items": ("replica", "make_probe_items"),
+    "probe_replica": ("replica", "probe_replica"),
+    "ServingCell": ("cell", "ServingCell"),
+}
+
+
+def __getattr__(name: str):
+    try:
+        mod_name, attr = _LAZY[name]
+    except KeyError:
+        raise AttributeError(name) from None
+    import importlib
+
+    mod = importlib.import_module(f".{mod_name}", __name__)
+    return getattr(mod, attr)

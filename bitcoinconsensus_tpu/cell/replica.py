@@ -1,0 +1,683 @@
+"""Cell replicas: supervised verify workers, each on its own port.
+
+A replica is one `IngressServer`+`VerifyServer` stack with its own
+`PersistentSigCache` store. Two implementations share one handle
+contract (`addr`, `is_alive()`, `kill()`, `restart()`):
+
+- `ReplicaProcess` — a real subprocess (`python -m
+  bitcoinconsensus_tpu.cell.replica`), the production shape: a kill -9
+  takes out the whole worker, and the chaos sweep does exactly that.
+  Alongside the ingress port it opens a JSON-line control channel
+  (stats / absorb / peek / flush) so the supervisor can drive sigstore
+  handoff across the process boundary.
+- `StubReplica` — the same stack in-process, for router-logic units
+  and the mini-workload leg where subprocess spawn cost buys nothing.
+
+`ReplicaSupervisor` health-checks replicas with known-answer probe
+verifies, reusing the guards.py sentinel discipline: every probe
+exercises BOTH verdict sides — one known-valid item must come back
+accepted and one known-corrupt item rejected — so a replica that fails
+open (accepts everything) is exactly as convicted as one that crashes.
+Probe failures accumulate per replica; at
+``BITCOINCONSENSUS_TPU_CELL_EVICT_AFTER`` consecutive failures
+(mirroring `ShardLadder`'s count-based eviction) the replica is
+evicted: flight-recorder conviction dump (carrying the failing probe
+events), router re-route, sigstore handoff. Restart follows bounded
+exponential backoff, and re-promotion only ever happens through a
+passing known-answer probe — the same discipline `degrade.py` applies
+to rungs.
+
+The supervisor is deliberately tick-driven (`tick()` advances one
+supervision round) so tests and the chaos sweep control time
+explicitly; `run_forever` wraps it in a thread for live cells.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import subprocess
+import sys
+import threading
+from typing import Callable, Dict, List, Optional, Tuple
+
+from ..obs import counter as _obs_counter
+from ..obs import flight as _flight
+from ..obs import gauge as _obs_gauge
+from ..obs import monotonic as _monotonic
+from ..resilience.degrade import HOST_LEVEL
+
+__all__ = [
+    "ReplicaProcess",
+    "ReplicaSupervisor",
+    "StubReplica",
+    "make_probe_items",
+    "probe_replica",
+]
+
+_G_HEALTHY = _obs_gauge(
+    "consensus_cell_replicas_healthy",
+    "replicas currently healthy (probe-passing) in the serving cell",
+)
+_C_EVICTIONS = _obs_counter(
+    "consensus_cell_evictions_total",
+    "replica evictions (crash or known-answer probe failure streak)",
+)
+_C_REPROMOTIONS = _obs_counter(
+    "consensus_cell_repromotions_total",
+    "replicas re-promoted to healthy after a passing known-answer probe",
+)
+
+
+def _env_int(name: str, default: int) -> int:
+    try:
+        return int(os.environ.get(name, ""))
+    except ValueError:
+        return default
+
+
+def _env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, ""))
+    except ValueError:
+        return default
+
+
+def _force_host(verifier) -> None:
+    """Pin a verifier's degradation ladder to the terminal host rung.
+
+    Replicas in CPU containers (tests, chaos) must never trigger a jit
+    compile — `inflight.dispatch` contains host-level tickets before
+    any device work, so parking the ladder on HOST_LEVEL (and pushing
+    the probe cadence out of reach, lest a probe dispatch compile) makes
+    a replica cost milliseconds instead of minutes while keeping the
+    verdict path host-exact."""
+    lad = verifier._resilience.ladder
+    lad._idx = lad.levels.index(HOST_LEVEL)
+    lad.probe_after = 1 << 30
+
+
+def make_probe_items():
+    """The known-answer probe pair: (must-accept item, must-reject item).
+
+    Deterministic single-signature spends (guards.py sentinel
+    discipline): the reject item's signature is well-formed but
+    cryptographically false, so a replica answering it `ok` has a
+    broken verify path, not a parse error."""
+    from ..core.flags import VERIFY_ALL_EXTENDED
+    from ..models.batch import BatchItem
+    from ..utils import blockgen
+
+    _, funded = blockgen.make_funded_view(
+        2, kinds=("p2wpkh",), seed="cell-probe"
+    )
+    items = []
+    for j, f in enumerate(funded):
+        tx = blockgen.build_spend_tx([f], corrupt_input=(0 if j else None))
+        items.append(
+            BatchItem(
+                tx.serialize(), 0, VERIFY_ALL_EXTENDED,
+                spent_outputs=[(f.amount, f.wallet.spk)],
+            )
+        )
+    return items[0], items[1]
+
+
+def probe_replica(
+    addr: Tuple[str, int], probe_items, timeout_s: float = 5.0
+) -> bool:
+    """One known-answer probe over the wire: accept item must verify
+    True AND reject item must verify False. Any transport error, shed,
+    or wrong-side verdict fails the probe — fail-closed."""
+    from ..serving.client import IngressClient
+
+    good, bad = probe_items
+    try:
+        with IngressClient(
+            addr[0], port=addr[1], timeout_s=timeout_s
+        ) as cli:
+            if not cli.verify(good, tenant="_probe").ok:
+                return False
+            if cli.verify(bad, tenant="_probe").ok:
+                return False
+        return True
+    except Exception:
+        return False
+
+
+# -- in-process stub ---------------------------------------------------
+
+
+class StubReplica:
+    """The replica stack in-process: real wire protocol, no subprocess.
+
+    Uses its own `TpuSecpVerifier` instance (never the process-global
+    default — stubs pin their ladder to the host rung and must not
+    mutate shared state). `kill()` is abrupt (no drain), modelling a
+    crash as closely as an in-process stub can; `force_sick` makes the
+    supervisor's probes fail without tearing anything down, for
+    deterministic eviction-threshold tests."""
+
+    def __init__(
+        self,
+        name: str,
+        store_dir: Optional[str] = None,
+        host_only: bool = True,
+        server_kw: Optional[dict] = None,
+    ):
+        self.name = name
+        self.store_dir = store_dir
+        self.host_only = host_only
+        self.server_kw = dict(server_kw or {})
+        self.force_sick = False
+        self.store = None
+        self._vs = None
+        self._ing = None
+
+    @property
+    def addr(self) -> Tuple[str, int]:
+        if self._ing is None:
+            raise RuntimeError("stub replica not started")
+        return ("127.0.0.1", self._ing.port)
+
+    def start(self) -> "StubReplica":
+        from ..crypto.jax_backend import TpuSecpVerifier
+        from ..models.sigcache import ScriptExecutionCache
+        from ..serving import IngressServer, VerifyServer
+
+        if self.store_dir is not None:
+            from ..models.sigstore import PersistentSigCache
+
+            self.store = PersistentSigCache(self.store_dir)
+        verifier = TpuSecpVerifier(min_batch=8)
+        if self.host_only:
+            _force_host(verifier)
+        self._vs = VerifyServer(
+            verifier=verifier,
+            sig_cache=self.store,
+            script_cache=ScriptExecutionCache(),
+            **self.server_kw,
+        ).start()
+        self._ing = IngressServer(self._vs).start()
+        return self
+
+    def is_alive(self) -> bool:
+        return self._ing is not None
+
+    def kill(self) -> None:
+        """Abrupt stop: no drain, in-flight sessions see a reset —
+        the closest an in-process stub gets to kill -9. The store's
+        appends are already on disk (one fsync'd record per mutation),
+        so closing it loses nothing a crash wouldn't keep."""
+        ing, vs, store = self._ing, self._vs, self.store
+        self._ing = self._vs = self.store = None
+        if ing is not None:
+            ing.close(drain=False)
+        if vs is not None:
+            vs.close(drain=False)
+        if store is not None:
+            store.close()
+
+    def restart(self) -> "StubReplica":
+        if self.is_alive():
+            self.kill()
+        return self.start()
+
+    def close(self) -> None:
+        self.kill()
+
+    # Control surface, mirroring the subprocess JSON protocol so cell
+    # plumbing (handoff absorb, stats) is handle-agnostic.
+    def control(self, obj: dict) -> dict:
+        cmd = obj.get("cmd")
+        if cmd == "ping":
+            return {"ok": True}
+        if self.store is None:
+            return {"ok": False, "error": "no store"}
+        if cmd == "stats":
+            return {
+                "ok": True,
+                "entries": len(self.store),
+                "probes": self.store._probes_since_open,
+                "hits": self.store._hits_since_open,
+            }
+        if cmd == "absorb":
+            from .sigtier import absorb_handoff
+
+            return {"ok": True, **absorb_handoff(self.store, obj["path"])}
+        if cmd == "peek":
+            return {
+                "ok": True,
+                "present": self.store.peek_key(bytes.fromhex(obj["key"])),
+            }
+        if cmd == "flush":
+            self.store.flush()
+            return {"ok": True}
+        return {"ok": False, "error": f"unknown cmd {cmd!r}"}
+
+
+# -- subprocess replica ------------------------------------------------
+
+_REPO_ROOT = os.path.dirname(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+
+class ReplicaProcess:
+    """A replica as a real OS process; see the module docstring.
+
+    The child prints ``READY <ingress_port> <ctrl_port>`` on stdout once
+    both sockets are bound, then blocks until its stdin reaches EOF
+    (closing our pipe end is the graceful-stop signal; `kill()` is
+    SIGKILL). Restart spawns a fresh process on fresh ephemeral ports —
+    the supervisor re-routes by name, so port churn is invisible above
+    the handle."""
+
+    def __init__(
+        self,
+        name: str,
+        store_dir: Optional[str] = None,
+        host_only: bool = True,
+        server_kw: Optional[dict] = None,
+        spawn_timeout_s: float = 120.0,
+    ):
+        self.name = name
+        self.store_dir = store_dir
+        self.host_only = host_only
+        self.server_kw = dict(server_kw or {})
+        self.spawn_timeout_s = spawn_timeout_s
+        self.port: Optional[int] = None
+        self.ctrl_port: Optional[int] = None
+        self._proc: Optional[subprocess.Popen] = None
+
+    @property
+    def addr(self) -> Tuple[str, int]:
+        if self.port is None:
+            raise RuntimeError("replica process not started")
+        return ("127.0.0.1", self.port)
+
+    def start(self) -> "ReplicaProcess":
+        cmd = [
+            sys.executable, "-m", "bitcoinconsensus_tpu.cell.replica",
+            "--name", self.name,
+        ]
+        if self.store_dir is not None:
+            cmd += ["--store-dir", self.store_dir]
+        if self.host_only:
+            cmd.append("--host-only")
+        for k, v in sorted(self.server_kw.items()):
+            cmd += [f"--{k.replace('_', '-')}", str(v)]
+        env = dict(os.environ)
+        if self.host_only:
+            # Must land before the child imports jax.
+            env["JAX_PLATFORMS"] = "cpu"
+        self._proc = subprocess.Popen(
+            cmd,
+            cwd=_REPO_ROOT,
+            env=env,
+            stdin=subprocess.PIPE,
+            stdout=subprocess.PIPE,
+            text=True,
+        )
+        ready: List[str] = []
+        evt = threading.Event()
+
+        def _reader() -> None:
+            for line in self._proc.stdout:
+                if line.startswith("READY "):
+                    ready.append(line.strip())
+                    evt.set()
+                    return
+            evt.set()  # EOF before READY: child died during startup
+
+        t = threading.Thread(target=_reader, daemon=True)
+        t.start()
+        if not evt.wait(self.spawn_timeout_s) or not ready:
+            self.kill()
+            raise RuntimeError(
+                f"replica {self.name!r} did not come up "
+                f"(rc={self._proc.poll()})"
+            )
+        _, port, ctrl = ready[0].split()
+        self.port, self.ctrl_port = int(port), int(ctrl)
+        return self
+
+    def is_alive(self) -> bool:
+        return self._proc is not None and self._proc.poll() is None
+
+    def kill(self) -> None:
+        if self._proc is not None:
+            try:
+                self._proc.kill()
+            except OSError:
+                pass
+            self._proc.wait()
+
+    def restart(self) -> "ReplicaProcess":
+        if self.is_alive():
+            self.kill()
+        return self.start()
+
+    def close(self) -> None:
+        """Graceful stop: close the stdin pipe (the child's exit
+        signal) and wait briefly; escalate to SIGKILL."""
+        if self._proc is None:
+            return
+        try:
+            self._proc.stdin.close()
+        except OSError:
+            pass
+        try:
+            self._proc.wait(timeout=15)
+        except subprocess.TimeoutExpired:
+            self.kill()
+
+    def control(self, obj: dict, timeout_s: float = 30.0) -> dict:
+        if self.ctrl_port is None:
+            raise RuntimeError("replica process not started")
+        with socket.create_connection(
+            ("127.0.0.1", self.ctrl_port), timeout=timeout_s
+        ) as sock:
+            sock.sendall((json.dumps(obj) + "\n").encode("utf-8"))
+            buf = bytearray()
+            while not buf.endswith(b"\n"):
+                chunk = sock.recv(65536)
+                if not chunk:
+                    break
+                buf.extend(chunk)
+        return json.loads(buf.decode("utf-8"))
+
+
+# -- supervisor --------------------------------------------------------
+
+
+class _ReplicaState:
+    __slots__ = ("healthy", "fail_streak", "attempts", "next_retry_at")
+
+    def __init__(self) -> None:
+        self.healthy = True
+        self.fail_streak = 0
+        self.attempts = 0
+        self.next_retry_at = 0.0
+
+
+class ReplicaSupervisor:
+    """Health-driven eviction/restart/re-promotion over replica handles.
+
+    Tick-driven: each `tick()` probes every healthy replica
+    (known-answer, both verdict sides) and advances restart backoff for
+    evicted ones. `on_evict`/`on_promote` are the cell's hooks — the
+    router flips routing health and the sigstore tier runs handoff
+    there, so supervision stays policy-only."""
+
+    def __init__(
+        self,
+        replicas: Dict[str, object],
+        probe_items=None,
+        evict_after: Optional[int] = None,
+        probe_timeout_s: Optional[float] = None,
+        backoff_s: float = 0.25,
+        max_backoff_s: float = 2.0,
+        on_evict: Optional[Callable[[str], None]] = None,
+        on_promote: Optional[Callable[[str], None]] = None,
+    ):
+        self.replicas = dict(replicas)
+        self.probe_items = (
+            probe_items if probe_items is not None else make_probe_items()
+        )
+        self.evict_after = (
+            evict_after
+            if evict_after is not None
+            else _env_int("BITCOINCONSENSUS_TPU_CELL_EVICT_AFTER", 3)
+        )
+        self.probe_timeout_s = (
+            probe_timeout_s
+            if probe_timeout_s is not None
+            else _env_float("BITCOINCONSENSUS_TPU_CELL_PROBE_TIMEOUT_S", 5.0)
+        )
+        self.backoff_s = backoff_s
+        self.max_backoff_s = max_backoff_s
+        self.on_evict = on_evict
+        self.on_promote = on_promote
+        self._state = {name: _ReplicaState() for name in self.replicas}
+        self.backoff_log: Dict[str, List[float]] = {
+            name: [] for name in self.replicas
+        }
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        _G_HEALTHY.set(len(self.replicas))
+
+    # -- introspection -------------------------------------------------
+
+    def healthy_names(self) -> List[str]:
+        with self._lock:
+            return [n for n, s in self._state.items() if s.healthy]
+
+    def is_healthy(self, name: str) -> bool:
+        with self._lock:
+            return self._state[name].healthy
+
+    def _set_gauge_locked(self) -> None:
+        _G_HEALTHY.set(sum(1 for s in self._state.values() if s.healthy))
+
+    # -- probing -------------------------------------------------------
+
+    def _probe(self, name: str) -> bool:
+        r = self.replicas[name]
+        if getattr(r, "force_sick", False):
+            return False
+        return probe_replica(r.addr, self.probe_items, self.probe_timeout_s)
+
+    # -- supervision round ---------------------------------------------
+
+    def tick(self) -> None:
+        """One supervision round over every replica. Serialized: probes
+        and membership transitions must not interleave."""
+        with self._lock:
+            for name, r in self.replicas.items():
+                st = self._state[name]
+                if st.healthy:
+                    self._tick_healthy_locked(name, r, st)
+                else:
+                    self._tick_evicted_locked(name, r, st)
+
+    def _tick_healthy_locked(self, name, r, st) -> None:
+        if not r.is_alive():
+            _flight.record("cell.probe", replica=name, ok=False,
+                           cause="dead")
+            self._evict_locked(name, st, reason="dead")
+            return
+        ok = self._probe(name)
+        _flight.record("cell.probe", replica=name, ok=ok)
+        if ok:
+            st.fail_streak = 0
+            return
+        st.fail_streak += 1
+        if st.fail_streak >= self.evict_after:
+            self._evict_locked(name, st, reason="probe")
+
+    def _tick_evicted_locked(self, name, r, st) -> None:
+        now = _monotonic()
+        if now < st.next_retry_at:
+            return
+        if not r.is_alive():
+            try:
+                r.restart()
+            except Exception:
+                self._backoff_locked(name, st, now)
+                return
+        ok = self._probe(name)
+        _flight.record("cell.probe", replica=name, ok=ok, phase="repromote")
+        if ok:
+            st.healthy = True
+            st.fail_streak = 0
+            st.attempts = 0
+            self._set_gauge_locked()
+            _C_REPROMOTIONS.inc()
+            _flight.record("cell.promote", replica=name)
+            if self.on_promote is not None:
+                self.on_promote(name)
+        else:
+            self._backoff_locked(name, st, now)
+
+    def _backoff_locked(self, name, st, now: float) -> None:
+        delay = min(self.backoff_s * (2 ** st.attempts), self.max_backoff_s)
+        st.attempts += 1
+        st.next_retry_at = now + delay
+        self.backoff_log[name].append(delay)
+
+    def _evict_locked(self, name, st, reason: str) -> None:
+        st.healthy = False
+        st.attempts = 0
+        st.next_retry_at = _monotonic() + self.backoff_s
+        self.backoff_log[name].append(self.backoff_s)
+        self._set_gauge_locked()
+        _C_EVICTIONS.inc()
+        # Record the conviction before triggering the dump so the dump
+        # carries it alongside the failing probe events (the same
+        # record-then-trigger order degrade.py uses).
+        _flight.record(
+            "cell.evict", replica=name, reason=reason,
+            fail_streak=st.fail_streak, evict_after=self.evict_after,
+        )
+        _flight.trigger("cell_eviction", replica=name, cause=reason)
+        if self.on_evict is not None:
+            self.on_evict(name)
+
+    # -- background loop -----------------------------------------------
+
+    def run_forever(self, interval_s: float = 0.5) -> "ReplicaSupervisor":
+        if self._thread is not None:
+            return self
+
+        def _loop() -> None:
+            while not self._stop.wait(interval_s):
+                self.tick()
+
+        self._thread = threading.Thread(
+            target=_loop, name="cell-supervisor", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(10)
+            self._thread = None
+
+
+# -- subprocess entry point --------------------------------------------
+
+
+def _serve_control(store, sock: socket.socket) -> None:
+    """JSON-line control loop: one command per connection."""
+    from .sigtier import absorb_handoff
+
+    while True:
+        try:
+            conn, _ = sock.accept()
+        except OSError:
+            return
+        try:
+            with conn:
+                fh = conn.makefile("rw", encoding="utf-8")
+                line = fh.readline()
+                if not line:
+                    continue
+                obj = json.loads(line)
+                cmd = obj.get("cmd")
+                if cmd == "ping":
+                    reply = {"ok": True}
+                elif store is None:
+                    reply = {"ok": False, "error": "no store"}
+                elif cmd == "stats":
+                    reply = {
+                        "ok": True,
+                        "entries": len(store),
+                        "probes": store._probes_since_open,
+                        "hits": store._hits_since_open,
+                    }
+                elif cmd == "absorb":
+                    reply = {"ok": True,
+                             **absorb_handoff(store, obj["path"])}
+                elif cmd == "peek":
+                    reply = {
+                        "ok": True,
+                        "present": store.peek_key(
+                            bytes.fromhex(obj["key"])
+                        ),
+                    }
+                elif cmd == "flush":
+                    store.flush()
+                    reply = {"ok": True}
+                else:
+                    reply = {"ok": False, "error": f"unknown cmd {cmd!r}"}
+                fh.write(json.dumps(reply) + "\n")
+                fh.flush()
+        except Exception:
+            continue  # a broken control exchange must not kill the replica
+
+
+def replica_main(argv: Optional[List[str]] = None) -> int:
+    import argparse
+
+    p = argparse.ArgumentParser(description="serving-cell replica worker")
+    p.add_argument("--name", required=True)
+    p.add_argument("--store-dir", default=None)
+    p.add_argument("--host-only", action="store_true")
+    p.add_argument("--max-batch", type=int, default=None)
+    p.add_argument("--flush-s", type=float, default=None)
+    p.add_argument("--tenant-depth", type=int, default=None)
+    args = p.parse_args(argv)
+
+    from ..crypto.jax_backend import TpuSecpVerifier
+    from ..models.sigcache import ScriptExecutionCache
+    from ..serving import IngressServer, VerifyServer
+
+    store = None
+    if args.store_dir is not None:
+        from ..models.sigstore import PersistentSigCache
+
+        store = PersistentSigCache(args.store_dir)
+    verifier = TpuSecpVerifier(min_batch=8)
+    if args.host_only:
+        _force_host(verifier)
+    server_kw = {}
+    if args.max_batch is not None:
+        server_kw["max_batch"] = args.max_batch
+    if args.flush_s is not None:
+        server_kw["flush_s"] = args.flush_s
+    if args.tenant_depth is not None:
+        server_kw["tenant_depth"] = args.tenant_depth
+    vs = VerifyServer(
+        verifier=verifier,
+        sig_cache=store,
+        script_cache=ScriptExecutionCache(),
+        **server_kw,
+    ).start()
+    ing = IngressServer(vs).start()
+    ctrl = socket.create_server(("127.0.0.1", 0))
+    threading.Thread(
+        target=_serve_control, args=(store, ctrl), daemon=True
+    ).start()
+    print(f"READY {ing.port} {ctrl.getsockname()[1]}", flush=True)
+    try:
+        sys.stdin.read()  # EOF = parent closed our pipe: shut down
+    except KeyboardInterrupt:
+        pass
+    ing.close(drain=True)
+    vs.close(drain=True)
+    try:
+        ctrl.close()
+    except OSError:
+        pass
+    if store is not None:
+        store.close()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(replica_main())

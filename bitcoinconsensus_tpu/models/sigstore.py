@@ -112,6 +112,11 @@ _S_APPEND_ERRORS = _obs_counter(
 _S_COMPACTIONS = _obs_counter(
     "consensus_sigstore_compactions_total", "shard-log compaction rewrites"
 )
+_S_SHARD_MOVED = _obs_counter(
+    "consensus_sigstore_shard_moved_total",
+    "shard backing files found missing mid-run (ownership moved away); "
+    "the shard restarts cold, the verify path never sees an error",
+)
 
 
 def _rec(op: bytes, key: bytes) -> bytes:
@@ -319,6 +324,13 @@ class PersistentSigCache(SigCache):
         try:
             _faults.maybe_raise("sigstore.append")
             self._logs[shard_i].append(op, key)
+        except FileNotFoundError:
+            # The shard's backing directory vanished: ownership moved
+            # away under the cell's handoff. Restart the shard cold —
+            # reads miss and recompute (fail-closed), nothing raises
+            # into the verify path.
+            self._shard_moved_locked(shard_i)
+            return
         except (OSError, _faults.InjectedFault):
             _S_APPEND_ERRORS.inc()
             return
@@ -328,11 +340,28 @@ class PersistentSigCache(SigCache):
         if self._records[shard_i] > 2 * live + _COMPACT_SLACK:
             try:
                 self._logs[shard_i].compact(self._cold[shard_i])
+            except FileNotFoundError:
+                self._shard_moved_locked(shard_i)
+                return
             except OSError:
                 _S_APPEND_ERRORS.inc()
                 return
             self._records[shard_i] = live
             _S_COMPACTIONS.inc()
+
+    def _shard_moved_locked(self, shard_i: int) -> None:
+        """Treat one shard as moved-away: drop its entries from both
+        tiers (it must not keep answering hits for keys whose records
+        now live elsewhere), close the stale handle, count it."""
+        _S_SHARD_MOVED.inc()
+        self._logs[shard_i].close()
+        gone = self._cold[shard_i]
+        self._cold[shard_i] = {}
+        self._entries -= len(gone)
+        self._records[shard_i] = 0
+        for k in gone:
+            self._set.pop(k, None)
+        self._set_tier_gauges()
 
     def _set_tier_gauges(self) -> None:
         _S_TIER.set(len(self._set), tier="hot")
@@ -430,6 +459,14 @@ class PersistentSigCache(SigCache):
             self._m_erases.inc()
             self._m_entries.set(self._entries)
 
+    def peek_key(self, k: bytes) -> bool:
+        """Presence check with NO side effects: no probe/hit accounting,
+        no LRU promotion, no metrics. For measurement surfaces (the cell
+        control channel's tombstone audit) that must not pollute the
+        warm-rate statistics they are trying to read."""
+        with self._lock:
+            return k in self._set or k in self._cold[self._shard_of(k)]
+
     def _evict_locked(self, k: bytes) -> bool:
         """Remove `k` from both in-RAM tiers; True when it was present."""
         self._set.pop(k, None)
@@ -450,10 +487,16 @@ class PersistentSigCache(SigCache):
     def flush(self) -> None:
         """fsync every shard log (tests / checkpoint barriers)."""
         with self._lock:
-            for log in self._logs:
-                if log._fh is not None:
+            for i, log in enumerate(self._logs):
+                if log._fh is None:
+                    continue
+                try:
                     log._fh.flush()
                     os.fsync(log._fh.fileno())
+                except FileNotFoundError:
+                    self._shard_moved_locked(i)
+                except OSError:
+                    _S_APPEND_ERRORS.inc()
 
     def close(self) -> None:
         with self._lock:

@@ -55,15 +55,35 @@ sanctioned ``obs`` clock (`analysis/host_lint.py` lints this package
 with the clock rule).
 """
 
-from .degrade import DispatchResilience, Ladder
 from .faults import FaultPlan, FaultSpec, InjectedFault, InjectedTimeout, inject
-from .guards import (
-    VerdictAnomaly,
-    install_sentinels,
-    set_cache_audit,
-    validate_verdict,
-)
-from .inflight import InflightQueue, Ticket, settle_array
+
+# `degrade`/`guards`/`inflight` pull in the jax stack; `faults` must not.
+# The sigstore tier chain (cell/sigtier.py → models/sigstore.py →
+# resilience/faults.py) is imported by bare subprocess workers that never
+# touch a device, so the heavy members resolve lazily.
+_LAZY = {
+    "DispatchResilience": ("degrade", "DispatchResilience"),
+    "Ladder": ("degrade", "Ladder"),
+    "VerdictAnomaly": ("guards", "VerdictAnomaly"),
+    "install_sentinels": ("guards", "install_sentinels"),
+    "set_cache_audit": ("guards", "set_cache_audit"),
+    "validate_verdict": ("guards", "validate_verdict"),
+    "InflightQueue": ("inflight", "InflightQueue"),
+    "Ticket": ("inflight", "Ticket"),
+    "settle_array": ("inflight", "settle_array"),
+}
+
+
+def __getattr__(name: str):
+    try:
+        mod_name, attr = _LAZY[name]
+    except KeyError:
+        raise AttributeError(name) from None
+    import importlib
+
+    mod = importlib.import_module(f".{mod_name}", __name__)
+    return getattr(mod, attr)
+
 
 __all__ = [
     "DispatchResilience",

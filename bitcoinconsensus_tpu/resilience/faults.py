@@ -39,6 +39,10 @@ Sites registered by the pipeline (grep for the literal):
                             (that shard starts cold; contained)
     sigstore.append         raise on a persistent-store log append (the
                             entry stays unpersisted; verdicts unaffected)
+    cell.route              raise on a cell-router client-session frame
+                            read (router partition: that session tears
+                            down, routing state and replicas survive,
+                            `verify_with_retry` reconnects)
 
 This module is host-side policy, never consensus; it is linted with the
 clock rule only (`analysis/host_lint.py`) and reads no clocks at all.

@@ -31,7 +31,7 @@ import random
 import socket
 import threading
 import time
-from typing import Optional, Union
+from typing import Optional, Sequence, Tuple, Union
 
 from ..api import Error
 from ..models.batch import BatchItem, BatchResult
@@ -67,18 +67,35 @@ class IngressClient:
     Connects lazily, reconnects on the call after a connection error,
     and correlates responses by request id (the server may interleave
     them out of request order). Thread-safe: calls serialize on an
-    internal lock, so shared use degrades to in-order exchanges."""
+    internal lock, so shared use degrades to in-order exchanges.
+
+    Failover: `endpoints` is an ordered list of (host, port) pairs —
+    replicas of one service (verdicts are pure functions of the item,
+    so any endpoint is as good as any other). A connection error
+    rotates to the next endpoint before the caller retries; a shed
+    rotates via `rotate()` from the retry loop (the shed endpoint is
+    the loaded one — the next may have headroom). With one endpoint
+    (the default) rotation is a no-op and behaviour is unchanged.
+    `IngressProtocolError` never rotates and is never retried: a
+    malformed request is malformed everywhere."""
 
     def __init__(
         self,
         host: str = "127.0.0.1",
         port: int = 0,
         timeout_s: float = 30.0,
+        endpoints: Optional[Sequence[Tuple[str, int]]] = None,
     ):
-        if port <= 0:
-            raise ValueError("port must be a bound ingress port")
-        self.host = host
-        self.port = port
+        if endpoints is None:
+            endpoints = [(host, port)]
+        if not endpoints:
+            raise ValueError("endpoints must be non-empty")
+        for _, p in endpoints:
+            if p <= 0:
+                raise ValueError("port must be a bound ingress port")
+        self._endpoints = [tuple(ep) for ep in endpoints]
+        self._ep = 0
+        self.host, self.port = self._endpoints[0]
         self.timeout_s = timeout_s
         self._sock: Optional[socket.socket] = None
         self._rid = 0
@@ -101,6 +118,23 @@ class IngressClient:
             except OSError:
                 pass
             self._sock = None
+
+    @property
+    def endpoint_count(self) -> int:
+        return len(self._endpoints)
+
+    def rotate(self) -> None:
+        """Advance to the next endpoint (no-op with one endpoint); the
+        next call connects there."""
+        with self._lock:
+            self._rotate_locked()
+
+    def _rotate_locked(self) -> None:
+        if len(self._endpoints) == 1:
+            return
+        self._drop_locked()
+        self._ep = (self._ep + 1) % len(self._endpoints)
+        self.host, self.port = self._endpoints[self._ep]
 
     def _sock_locked(self) -> socket.socket:
         if self._sock is None:
@@ -133,8 +167,10 @@ class IngressClient:
                 return self._await_response_locked(sock, rid)
             except (ConnectionError, socket.timeout, OSError) as e:
                 # The session is in an unknown framing state: drop it so
-                # the next call starts clean on a fresh connection.
+                # the next call starts clean — on the next endpoint, if
+                # this client has more than one.
                 self._drop_locked()
+                self._rotate_locked()
                 if isinstance(e, ConnectionError):
                     raise
                 raise ConnectionError(str(e)) from e
@@ -199,6 +235,11 @@ def verify_with_retry(
         except OverloadError:
             if attempt >= retries:
                 raise
+            # A shed names THIS endpoint as loaded; a sibling replica
+            # may have headroom. Connection errors already rotated
+            # inside `verify`, so only the shed path rotates here.
+            if not in_proc and getattr(server, "endpoint_count", 1) > 1:
+                server.rotate()
         except ConnectionError:
             # Wire transport only: a dropped session is retryable (the
             # client reconnects), a protocol reject never is.

@@ -65,6 +65,7 @@ from .replay import (  # noqa: E402
     ReplayConfig,
     generate_stream,
     run_replay,
+    run_replay_cell,
     run_replay_serving,
 )
 from .diff_fuzz import backend_verdicts, run_diff_fuzz  # noqa: E402
@@ -78,6 +79,7 @@ __all__ = [
     "ReplayConfig",
     "generate_stream",
     "run_replay",
+    "run_replay_cell",
     "run_replay_serving",
     "backend_verdicts",
     "run_diff_fuzz",

@@ -41,6 +41,7 @@ __all__ = [
     "ReplayBlock",
     "generate_stream",
     "run_replay",
+    "run_replay_cell",
     "run_replay_serving",
 ]
 
@@ -383,5 +384,97 @@ def run_replay_serving(
         "all_accounted": all_accounted and not errors and not hung,
         "sheds_expected": overload,
         "sheds_happened": (len(sheds) > 0) if overload else True,
+        "sheds_explicit_only": all_accounted,
+    }
+
+
+def run_replay_cell(
+    cfg: ReplayConfig,
+    n_replicas: int = 2,
+    timeout_s: float = 120.0,
+) -> dict:
+    """The cell path: the same bursty multi-tenant stream, but through
+    the `CellRouter` fronting `n_replicas` replica stacks (in-process
+    stubs — the gauntlet leg proves routing + protocol end-to-end; the
+    chaos sweep owns the subprocess kill trials). The criteria are
+    `run_replay_serving`'s: every submission settles oracle-identical
+    or is explicitly shed; hangs and silent drops are failures."""
+    from ..cell import ServingCell
+    from ..serving import OverloadError
+    from ..serving.client import IngressClient, verify_with_retry
+    from . import GAUNTLET_DIVERGENCE
+
+    blocks = generate_stream(cfg)
+    items: List[BatchItem] = [
+        it for blk in blocks for it in blk.block_items
+    ]
+    oracle = [_norm(t) for t in _oracle(items)]
+
+    lanes = [(i, it) for i, it in enumerate(items)]
+    per_tenant: List[List[Tuple[int, BatchItem]]] = [
+        lanes[t :: cfg.tenants] for t in range(cfg.tenants)
+    ]
+
+    settled: Dict[int, Tuple[bool, str, Optional[str]]] = {}
+    sheds: List[int] = []
+    errors: List[str] = []
+    lock = threading.Lock()
+
+    def tenant_worker(t: int, port: int) -> None:
+        rng = random.Random((cfg.seed << 8) | t)
+        cli = IngressClient(port=port, timeout_s=timeout_s)
+        try:
+            for idx, it in per_tenant[t]:
+                try:
+                    res = verify_with_retry(
+                        cli, it, tenant=f"tenant{t}", retries=4, rng=rng
+                    )
+                    with lock:
+                        settled[idx] = _triple(res)
+                except OverloadError:
+                    with lock:
+                        sheds.append(idx)
+                except Exception as e:  # noqa: BLE001 — trial accounting
+                    with lock:
+                        errors.append(f"cell[{idx}]: {e!r}")
+        finally:
+            cli.close()
+
+    cell = ServingCell(
+        n_replicas=n_replicas,
+        stub=True,
+        server_kw=dict(max_batch=16, flush_s=0.005, tenant_depth=256),
+    ).start()
+    try:
+        threads = [
+            threading.Thread(target=tenant_worker, args=(t, cell.port))
+            for t in range(cfg.tenants)
+        ]
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join(timeout=timeout_s)
+        hung = [th for th in threads if th.is_alive()]
+    finally:
+        cell.close()
+
+    divergences = [
+        {"item": idx, "got": got, "want": oracle[idx]}
+        for idx, got in sorted(settled.items())
+        if got != oracle[idx]
+    ]
+    GAUNTLET_DIVERGENCE.inc(len(divergences), leg="replay-cell")
+    all_accounted = len(settled) + len(sheds) == len(items)
+    return {
+        "mode": "cell",
+        "replicas": n_replicas,
+        "items": len(items),
+        "settled": len(settled),
+        "sheds": len(sheds),
+        "errors": errors,
+        "hung_threads": len(hung),
+        "divergences": divergences,
+        "bit_identical": not divergences,
+        "all_accounted": all_accounted and not errors and not hung,
         "sheds_explicit_only": all_accounted,
     }

@@ -92,6 +92,15 @@ def run_replay_leg(seed: int, blocks: int, txs: int) -> dict:
     }
 
 
+def run_cell_leg(seed: int) -> dict:
+    from bitcoinconsensus_tpu.workloads import ReplayConfig, run_replay_cell
+
+    small = ReplayConfig(seed=seed + 2, n_blocks=2, txs_per_block=3)
+    cell = run_replay_cell(small, n_replicas=2)
+    cell["ok"] = cell["bit_identical"] and cell["all_accounted"]
+    return {"ok": cell["ok"], "cell": cell}
+
+
 def run_corpus_leg() -> dict:
     from bitcoinconsensus_tpu.workloads.corpus import run_corpus_check
 
@@ -121,7 +130,7 @@ def _problems(report: dict) -> list:
     for leg, rep in report["legs"].items():
         if not rep["ok"]:
             probs.append(f"{leg}: leg failed")
-        for sub in ("stream", "serving", "overload", "ingress"):
+        for sub in ("stream", "serving", "overload", "ingress", "cell"):
             r = rep.get(sub)
             if r is None:
                 continue
@@ -150,6 +159,9 @@ def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--replay", action="store_true", help="replay leg only")
+    ap.add_argument("--cell", action="store_true",
+                    help="cell leg only (replay through the serving-cell "
+                    "router)")
     ap.add_argument("--corpus", action="store_true", help="corpus leg only")
     ap.add_argument("--fuzz", type=int, metavar="N", default=0,
                     help="fuzz leg only, with N mutated cases")
@@ -164,11 +176,13 @@ def main(argv=None) -> int:
                     help="write the JSON gauntlet report to this path")
     args = ap.parse_args(argv)
 
-    all_legs = not (args.replay or args.corpus or args.fuzz)
+    all_legs = not (args.replay or args.cell or args.corpus or args.fuzz)
     t0 = time.time()
     legs = {}
     if args.replay or all_legs:
         legs["replay"] = run_replay_leg(args.seed, args.blocks, args.txs)
+    if args.cell or all_legs:
+        legs["cell"] = run_cell_leg(args.seed)
     if args.corpus or all_legs:
         legs["corpus"] = run_corpus_leg()
     if args.fuzz or all_legs:

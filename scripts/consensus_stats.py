@@ -122,6 +122,23 @@ REQUIRED_METRICS = [
     "consensus_sigstore_warmup_seconds",
     "consensus_sigstore_replay_records_total",
     "consensus_sigstore_appends_total",
+    # serving cell (cell/: tenant-hash router + supervised replicas +
+    # sigstore tier; the workload's cell leg runs two in-process
+    # replicas, kills one, and drives the evict -> handoff -> reroute ->
+    # re-promote loop for real. A retried frame needs a frame in flight
+    # at the instant an upstream dies — inherently racy — so that
+    # counter reports an explicit zero sample)
+    "consensus_cell_replicas_healthy",
+    "consensus_cell_evictions_total",
+    "consensus_cell_repromotions_total",
+    "consensus_cell_reroutes_total",
+    "consensus_cell_retried_frames_total",
+    "consensus_cell_handoffs_total",
+    "consensus_cell_handoff_records_total",
+    # sigstore shard ownership moved away mid-append (cell handoff):
+    # the workload rips a store's directory out from under it and the
+    # next append must restart the shard cold, counted, never raising
+    "consensus_sigstore_shard_moved_total",
     # adversarial gauntlet (workloads/: corpus pins, replay stream,
     # differential fuzz; the divergence counter reports explicit zero
     # samples per leg — "ran and agreed", not merely "absent")
@@ -287,6 +304,55 @@ def run_mini_workload() -> None:
         verify_batch(good, sig_cache=store2,
                      script_cache=ScriptExecutionCache(cache_label="ss2"))
         assert store2.warmup_s is not None  # >=90% hits on the repeat
+
+    # --- serving cell: two in-process replicas behind the tenant-hash
+    # router; kill one and drive the full failure loop for real —
+    # dead-replica eviction, sigstore shard handoff to the survivor,
+    # tenant re-route, then restart + known-answer re-promotion. A
+    # retried frame needs a frame in flight at the instant an upstream
+    # dies (inherently racy), so that counter samples an explicit zero ---
+    import shutil
+    import time as timelib
+
+    from bitcoinconsensus_tpu.cell import ServingCell
+    from bitcoinconsensus_tpu.cell.router import _C_RETRIED
+
+    with ServingCell(
+        n_replicas=2, stub=True,
+        server_kw=dict(max_batch=8, flush_s=0.005),
+        evict_after=1, backoff_s=0.02, max_backoff_s=0.05,
+    ) as cell:
+        cellcli = IngressClient(port=cell.port, timeout_s=60)
+        try:
+            assert cellcli.verify(items[0], tenant="cell-t0").ok
+            victim = cell.router._home.lookup("cell-t0")
+            cell.replicas[victim].kill()
+            cell.tick()  # dead -> evict -> shard handoff to the survivor
+            assert victim not in cell.healthy_names()
+            # The victim's tenant must verify again via the survivor
+            # (lights the reroute counter on its real code path).
+            assert cellcli.verify(items[0], tenant="cell-t0").ok
+            deadline = timelib.monotonic() + 60
+            while (victim not in cell.healthy_names()
+                   and timelib.monotonic() < deadline):
+                timelib.sleep(0.06)
+                cell.tick()  # restart + passing known-answer probe
+            assert victim in cell.healthy_names()
+        finally:
+            cellcli.close()
+    _C_RETRIED.inc(0)  # explicit zero: no frame in flight at link death
+
+    # A store whose directory vanishes mid-append (shard ownership moved
+    # away under a cell handoff) must restart the shard cold — counted,
+    # never raised into the verify path.
+    sdir2 = tempfile.mkdtemp(prefix="stats-shard-moved-")
+    store3 = PersistentSigCache(sdir2, hot_entries=16, shards=2)
+    shutil.rmtree(sdir2)
+    store3.add_key(b"\x07" * 32)  # lazy shard open hits the gone dir
+    # The moved shard restarts cold: it must NOT keep answering for
+    # keys whose records now live elsewhere.
+    assert not store3.peek_key(b"\x07" * 32) and len(store3) == 0
+    store3.close()
 
     # --- block connect: one valid block, one failing replay ---
     bview, bfunded = blockgen.make_funded_view(4, height=1, seed="stats-blk")

@@ -1,0 +1,774 @@
+"""Serving-cell tests: tenant-hash router, health-driven failover,
+consistent-hash sigstore tier with shard handoff.
+
+The cell's claim is a process-level restatement of the store's: any
+single replica can die (kill -9, fail-open verify path, partition) and
+the cell keeps answering — every admitted verdict bit-identical, every
+loss explicit (typed ERR or retried exactly once), cached entries
+following their shard's ownership with tombstones preserved. These
+tests pin each layer separately (ring, supervisor, router, tier) plus
+the wired loop end to end.
+"""
+
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+
+import pytest
+
+from conftest import *  # noqa: F401,F403 (env setup)
+
+from bitcoinconsensus_tpu.cell import (
+    HashRing,
+    ServingCell,
+    SigTier,
+    absorb_handoff,
+    iter_shard_records,
+    write_handoff,
+)
+from bitcoinconsensus_tpu.cell.replica import (
+    _C_REPROMOTIONS,
+    ReplicaSupervisor,
+    StubReplica,
+    make_probe_items,
+    probe_replica,
+)
+from bitcoinconsensus_tpu.cell.router import _C_REROUTES
+from bitcoinconsensus_tpu.core.flags import VERIFY_ALL_LIBCONSENSUS
+from bitcoinconsensus_tpu.models.batch import BatchItem
+from bitcoinconsensus_tpu.models.sigstore import (
+    _REC_LEN,
+    _S_SHARD_MOVED,
+    PersistentSigCache,
+)
+from bitcoinconsensus_tpu.resilience.faults import (
+    FaultPlan,
+    FaultSpec,
+    inject,
+)
+from bitcoinconsensus_tpu.serving import (
+    IngressClient,
+    IngressProtocolError,
+    IngressServer,
+    OverloadError,
+    VerifyServer,
+)
+from bitcoinconsensus_tpu.serving.client import verify_with_retry
+from bitcoinconsensus_tpu.serving.ingress import (
+    ERR_PROTO_BAD_TYPE,
+    ERR_PROTO_MALFORMED,
+    FRAME_ERR,
+    FRAME_REQ,
+    FRAME_RESP,
+    HEADER_LEN,
+    decode_error_payload,
+    decode_header,
+    decode_response_payload,
+    encode_frame,
+    encode_request,
+)
+
+from test_batch import make_p2wpkh_spend
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _item(label, corrupt=False):
+    txb, spk, amt = make_p2wpkh_spend(label, corrupt=corrupt)
+    return BatchItem(txb, 0, VERIFY_ALL_LIBCONSENSUS,
+                     spent_output_script=spk, amount=amt)
+
+
+def _cell(**kw):
+    kw.setdefault("n_replicas", 2)
+    kw.setdefault("stub", True)
+    kw.setdefault("server_kw", dict(max_batch=8, flush_s=0.005))
+    return ServingCell(**kw).start()
+
+
+def _keys(n, seed=0):
+    return [
+        bytes([(seed + i) % 256]) + (seed + i).to_bytes(31, "little")
+        for i in range(n)
+    ]
+
+
+def _dead_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _host_vs():
+    """A VerifyServer whose ladder is parked on the host rung: client
+    tests must measure failover, never a first-dispatch jit compile."""
+    from bitcoinconsensus_tpu.cell.replica import _force_host
+    from bitcoinconsensus_tpu.crypto.jax_backend import TpuSecpVerifier
+
+    verifier = TpuSecpVerifier(min_batch=8)
+    _force_host(verifier)
+    return VerifyServer(verifier=verifier, max_batch=8, flush_s=0.005)
+
+
+# -- consistent-hash ring ----------------------------------------------
+
+
+def test_ring_deterministic():
+    a = HashRing(["r0", "r1", "r2"])
+    b = HashRing(["r2", "r0", "r1"])  # insertion order must not matter
+    for i in range(200):
+        assert a.lookup(f"tenant{i}") == b.lookup(f"tenant{i}")
+
+
+def test_ring_minimal_movement_on_remove():
+    ring = HashRing(["r0", "r1", "r2"])
+    before = {f"t{i}": ring.lookup(f"t{i}") for i in range(300)}
+    ring.remove("r1")
+    moved = 0
+    for t, owner in before.items():
+        if owner == "r1":
+            assert ring.lookup(t) in ("r0", "r2")
+        elif ring.lookup(t) != owner:
+            moved += 1
+    # Consistent hashing: keys owned by survivors never move.
+    assert moved == 0
+
+
+def test_ring_distribution_balanced():
+    ring = HashRing(["r0", "r1"])
+    owners = [ring.lookup(f"tenant{i}") for i in range(400)]
+    share = owners.count("r0") / len(owners)
+    assert 0.2 < share < 0.8  # vnodes keep the split non-degenerate
+
+
+def test_ring_lookup_chain_and_empty():
+    ring = HashRing(["r0", "r1", "r2"])
+    chain = ring.lookup_chain("tenant7")
+    assert chain[0] == ring.lookup("tenant7")
+    assert sorted(chain) == ["r0", "r1", "r2"]  # each member once
+    empty = HashRing()
+    assert empty.lookup("x") is None
+    assert empty.lookup_chain("x") == []
+    assert len(empty) == 0 and "r0" not in empty
+
+
+# -- request codec: the router's cheap tenant peek ---------------------
+
+
+def test_request_payload_tenant_peek():
+    """rid and tenant prefix the REQ payload by design: the router must
+    be able to route without decoding the item it forwards."""
+    item = _item("cell-codec")
+    payload = encode_request(0x01020304, "tenant-x", item)
+    assert payload[0:4] == (0x01020304).to_bytes(4, "big")
+    tlen = int.from_bytes(payload[4:6], "big")
+    assert payload[6 : 6 + tlen] == b"tenant-x"
+
+
+# -- sigstore tier: records, handoff, tombstones -----------------------
+
+
+def test_iter_records_stops_at_corruption(tmp_path):
+    s = PersistentSigCache(str(tmp_path / "src"), shards=1)
+    for k in _keys(5):
+        s.add_key(k)
+    s.close()
+    log = str(tmp_path / "src" / "shard-00.log")
+    assert len(list(iter_shard_records(log))) == 5
+    with open(log, "r+b") as fh:  # flip one byte inside record 3
+        fh.seek(2 * _REC_LEN + 5)
+        b = fh.read(1)
+        fh.seek(2 * _REC_LEN + 5)
+        fh.write(bytes([b[0] ^ 0xFF]))
+    # Fail-closed: the stream stops BEFORE the corrupt record; nothing
+    # past an untrusted byte is handed to a receiver.
+    assert len(list(iter_shard_records(log))) == 2
+    assert os.path.getsize(log) == 5 * _REC_LEN  # source never modified
+
+
+def test_write_handoff_atomic_and_ordered(tmp_path):
+    ks = _keys(8)
+    s = PersistentSigCache(str(tmp_path / "src"), shards=1)
+    for k in ks:
+        s.add_key(k)
+    s.discard_key(ks[3])  # ADD…DEL sequence must survive in order
+    s.close()
+    log = str(tmp_path / "src" / "shard-00.log")
+    out = str(tmp_path / "handoff.log")
+    n = write_handoff([log], out)
+    assert n == 9
+    assert not os.path.exists(out + ".tmp")  # tmp+rename idiom
+    assert list(iter_shard_records(out)) == list(iter_shard_records(log))
+
+
+def test_absorb_tombstone_wins(tmp_path):
+    """A key the departed owner convicted (ADD then DEL) must end
+    absent in the receiver — even when the receiver had cached it
+    independently."""
+    k = _keys(1, seed=7)[0]
+    src = PersistentSigCache(str(tmp_path / "src"), shards=1)
+    src.add_key(k)
+    src.discard_key(k)
+    src.close()
+    out = str(tmp_path / "handoff.log")
+    write_handoff([str(tmp_path / "src" / "shard-00.log")], out)
+
+    recv = PersistentSigCache(str(tmp_path / "recv"), shards=1)
+    recv.add_key(k)  # independently cached
+    rep = absorb_handoff(recv, out)
+    assert rep == {"records": 2, "adds": 1, "dels": 1}
+    assert not recv.peek_key(k)
+    recv.close()
+
+
+def test_absorb_persists_across_reopen(tmp_path):
+    ks = _keys(6, seed=20)
+    src = PersistentSigCache(str(tmp_path / "src"), shards=1)
+    for k in ks:
+        src.add_key(k)
+    src.close()
+    out = str(tmp_path / "handoff.log")
+    write_handoff([str(tmp_path / "src" / "shard-00.log")], out)
+    recv_dir = str(tmp_path / "recv")
+    recv = PersistentSigCache(recv_dir, shards=2)
+    absorb_handoff(recv, out)
+    recv.close()
+    # Absorption goes through the receiver's own logs: a restart warms.
+    recv2 = PersistentSigCache(recv_dir, shards=2)
+    assert all(recv2.peek_key(k) for k in ks)
+    recv2.close()
+
+
+def test_tier_shared_salt(tmp_path):
+    tier = SigTier(str(tmp_path), shards=4)
+    da = tier.join("a")
+    db = tier.join("b")
+    with open(os.path.join(str(tmp_path), "salt"), "rb") as fh:
+        root_salt = fh.read()
+    sa = PersistentSigCache(da)
+    sb = PersistentSigCache(db)
+    # Without one keyspace a handed-off log would be meaningless bytes.
+    assert sa._salt == sb._salt == root_salt
+    sa.close()
+    sb.close()
+    assert tier.shard_owner(0) in ("a", "b")
+    tier.leave("a")
+    assert tier.shard_owner(0) == "b"
+
+
+# -- sigstore: shard directory disappears under handoff ----------------
+
+
+def test_shard_dir_disappears_counted_never_raises(tmp_path):
+    import shutil
+
+    moved0 = _S_SHARD_MOVED.value()
+    d = str(tmp_path / "store")
+    s = PersistentSigCache(d, hot_entries=8, shards=2)
+    shutil.rmtree(d)  # ownership moved away under the cell's handoff
+    k = _keys(1)[0]
+    s.add_key(k)  # lazy shard open hits the gone dir: must NOT raise
+    assert _S_SHARD_MOVED.value() == moved0 + 1
+    # The moved shard restarts cold: no hits for keys whose records now
+    # live elsewhere (fail-closed), and the store keeps serving.
+    assert not s.peek_key(k) and len(s) == 0
+    assert not s.contains_key(k)
+    s.close()
+
+
+def test_kill9_during_absorb_heals_to_record_boundary(tmp_path):
+    """SIGKILL a receiver mid-absorb (in a subprocess that never
+    imports jax — the tier must be usable from bare workers): on
+    reopen every receiver log heals to a whole-record boundary and the
+    absorbed prefix replays."""
+    src = PersistentSigCache(str(tmp_path / "src"), shards=1)
+    for k in _keys(8000):
+        src.add_key(k)
+    src.close()
+    out = str(tmp_path / "handoff.log")
+    assert write_handoff(
+        [str(tmp_path / "src" / "shard-00.log")], out) == 8000
+    recv_dir = str(tmp_path / "recv")
+
+    code = (
+        "import sys\n"
+        "sys.path.insert(0, %r)\n"
+        "from bitcoinconsensus_tpu.cell.sigtier import absorb_handoff\n"
+        "from bitcoinconsensus_tpu.models.sigstore import "
+        "PersistentSigCache\n"
+        "assert 'jax' not in sys.modules  # tier import chain is jax-free\n"
+        "s = PersistentSigCache(%r, hot_entries=8, shards=4)\n"
+        "print('ready', flush=True)\n"
+        "absorb_handoff(s, %r)\n"
+    ) % (_REPO, recv_dir, out)
+    proc = subprocess.Popen(
+        [sys.executable, "-c", code],
+        stdout=subprocess.PIPE,
+        stderr=subprocess.DEVNULL,
+    )
+    try:
+        assert proc.stdout.readline().strip() == b"ready"
+        time.sleep(0.05)  # let the absorb loop run hot
+        proc.send_signal(signal.SIGKILL)
+        proc.wait(30)
+    finally:
+        if proc.poll() is None:  # pragma: no cover - cleanup
+            proc.kill()
+
+    recv = PersistentSigCache(recv_dir, hot_entries=8, shards=4)
+    assert recv.replay_applied == len(recv)
+    for p in os.listdir(recv_dir):
+        if p.endswith(".log"):
+            sz = os.path.getsize(os.path.join(recv_dir, p))
+            assert sz % _REC_LEN == 0  # healed to the record boundary
+    extra = _keys(1, seed=9999)[0]
+    recv.add_key(extra)  # keeps accepting writes on the clean boundary
+    assert recv.peek_key(extra)
+    recv.close()
+
+
+# -- supervisor: probes, eviction threshold, backoff -------------------
+
+
+class _FakeReplica:
+    """Handle-contract stub for supervisor policy tests (no sockets)."""
+
+    def __init__(self, alive=True, sick=True):
+        self._alive = alive
+        self.force_sick = sick
+        self.addr = ("127.0.0.1", 1)
+        self.restarts = 0
+
+    def is_alive(self):
+        return self._alive
+
+    def restart(self):
+        self.restarts += 1
+        raise RuntimeError("still dead")
+
+
+def test_dead_replica_evicts_on_first_tick():
+    evicted = []
+    sup = ReplicaSupervisor(
+        {"x": _FakeReplica(alive=False)},
+        probe_items=(None, None), evict_after=3,
+        on_evict=evicted.append,
+    )
+    sup.tick()
+    assert evicted == ["x"] and sup.healthy_names() == []
+
+
+def test_probe_failure_evicts_exactly_at_threshold():
+    evicted = []
+    sup = ReplicaSupervisor(
+        {"x": _FakeReplica(alive=True, sick=True)},
+        probe_items=(None, None), evict_after=3,
+        on_evict=evicted.append,
+    )
+    sup.tick()
+    sup.tick()
+    assert sup.is_healthy("x") and not evicted  # never early
+    sup.tick()
+    assert not sup.is_healthy("x") and evicted == ["x"]
+
+
+def test_restart_backoff_bounded_and_monotone():
+    sup = ReplicaSupervisor(
+        {"x": _FakeReplica(alive=False)},
+        probe_items=(None, None), evict_after=1,
+        backoff_s=0.1, max_backoff_s=0.4,
+    )
+    sup.tick()  # dead -> evicted
+    for _ in range(6):  # every restart attempt keeps failing
+        sup._state["x"].next_retry_at = 0.0  # pin time: policy only
+        sup.tick()
+    log = sup.backoff_log["x"]
+    assert len(log) == 7
+    assert all(d <= 0.4 + 1e-9 for d in log)
+    assert all(a <= b + 1e-9 for a, b in zip(log, log[1:]))
+    assert log[-1] == 0.4  # capped, still retrying
+
+
+def test_probe_requires_both_verdict_sides():
+    """A replica that fails open (accepts the known-corrupt item) is
+    exactly as convicted as one that crashes — guards.py sentinel
+    discipline over the wire."""
+    good, bad = make_probe_items()
+    stub = StubReplica("p", server_kw=dict(max_batch=8, flush_s=0.005))
+    stub.start()
+    try:
+        assert probe_replica(stub.addr, (good, bad))
+        # Swap the reject side for a second known-valid item: the probe
+        # MUST fail, because nothing proved rejection still works.
+        assert not probe_replica(stub.addr, (good, good))
+        assert not probe_replica(("127.0.0.1", _dead_port()), (good, bad))
+    finally:
+        stub.close()
+
+
+def test_repromotion_only_through_passing_probe():
+    stub = StubReplica("p", server_kw=dict(max_batch=8, flush_s=0.005))
+    stub.start()
+    try:
+        sup = ReplicaSupervisor(
+            {"p": stub}, evict_after=1, backoff_s=0.01, max_backoff_s=0.02,
+        )
+        rep0 = _C_REPROMOTIONS.value()
+        stub.force_sick = True
+        sup.tick()
+        assert not sup.is_healthy("p")
+        sup._state["p"].next_retry_at = 0.0
+        sup.tick()  # probe still failing: must stay evicted
+        assert not sup.is_healthy("p")
+        assert _C_REPROMOTIONS.value() == rep0
+        stub.force_sick = False
+        sup._state["p"].next_retry_at = 0.0
+        sup.tick()  # passing known-answer probe: re-promoted
+        assert sup.is_healthy("p")
+        assert _C_REPROMOTIONS.value() == rep0 + 1
+    finally:
+        stub.close()
+
+
+# -- router: tenant mapping, failover, explicit errors -----------------
+
+
+def test_router_routes_tenant_to_home_replica():
+    cell = _cell()
+    try:
+        tenant = "map-tenant"
+        home = cell.router._home.lookup(tenant)
+        other = next(n for n in cell.replicas if n != home)
+        e0 = {n: cell.replicas[n].control({"cmd": "stats"})["entries"]
+              for n in cell.replicas}
+        with IngressClient(port=cell.port, timeout_s=60) as cli:
+            assert cli.verify(_item("cell-map"), tenant=tenant).ok
+        e1 = {n: cell.replicas[n].control({"cmd": "stats"})["entries"]
+              for n in cell.replicas}
+        assert e1[home] > e0[home]  # the verdict cached on the home
+        assert e1[other] == e0[other]
+    finally:
+        cell.close()
+
+
+def test_router_reroutes_sick_member_and_counts():
+    cell = _cell()
+    try:
+        tenant = "sick-tenant"
+        home = cell.router._home.lookup(tenant)
+        cell.router.set_healthy(home, False)
+        r0 = _C_REROUTES.value()
+        with IngressClient(port=cell.port, timeout_s=60) as cli:
+            assert cli.verify(_item("cell-sick"), tenant=tenant).ok
+            assert not cli.verify(
+                _item("cell-sick-bad", corrupt=True), tenant=tenant
+            ).ok
+        assert _C_REROUTES.value() >= r0 + 2
+    finally:
+        cell.close()
+
+
+def test_router_dead_replica_explicit_error_then_reroute():
+    """A frame for a dead-but-not-yet-evicted replica must come back as
+    an explicit typed retryable ERR — never silence — and flip to the
+    survivor the moment health does."""
+    cell = _cell()
+    try:
+        tenant = "dead-tenant"
+        home = cell.router._home.lookup(tenant)
+        cell.replicas[home].kill()
+        cli = IngressClient(port=cell.port, timeout_s=60)
+        try:
+            with pytest.raises(OverloadError) as ei:
+                cli.verify(_item("cell-dead"), tenant=tenant)
+            assert "replica_connect" in str(ei.value.reason)
+            cell.router.set_healthy(home, False)
+            assert cli.verify(_item("cell-dead"), tenant=tenant).ok
+        finally:
+            cli.close()
+    finally:
+        cell.close()
+
+
+def test_router_no_replica_explicit_and_session_survives():
+    cell = _cell()
+    try:
+        for name in cell.replicas:
+            cell.router.set_healthy(name, False)
+        cli = IngressClient(port=cell.port, timeout_s=60)
+        try:
+            with pytest.raises(OverloadError) as ei:
+                cli.verify(_item("cell-none"), tenant="t")
+            assert "no_replica" in str(ei.value.reason)
+            for name, r in cell.replicas.items():
+                cell.router.set_healthy(name, True)
+            # Same client session: a shed never closes it.
+            assert cli.verify(_item("cell-none"), tenant="t").ok
+        finally:
+            cli.close()
+    finally:
+        cell.close()
+
+
+def test_router_preserves_rids_pipelined():
+    cell = _cell()
+    try:
+        items = [_item(f"cell-rid-{i}") for i in range(4)]
+        rids = [101, 202, 303, 404]
+        sock = socket.create_connection(("127.0.0.1", cell.port),
+                                        timeout=60)
+        sock.settimeout(60)
+        got = {}
+        try:
+            for j, rid in enumerate(rids):  # two tenants, both replicas
+                sock.sendall(encode_frame(
+                    FRAME_REQ, encode_request(rid, f"t{j % 2}", items[j])
+                ))
+            for _ in rids:
+                hdr = b""
+                while len(hdr) < HEADER_LEN:
+                    hdr += sock.recv(HEADER_LEN - len(hdr))
+                ftype, ln = decode_header(hdr)
+                payload = b""
+                while len(payload) < ln:
+                    payload += sock.recv(ln - len(payload))
+                assert ftype == FRAME_RESP
+                rid, res = decode_response_payload(payload)
+                got[rid] = res.ok
+        finally:
+            sock.close()
+        assert set(got) == set(rids)  # client-chosen rids, end to end
+        assert all(got.values())
+    finally:
+        cell.close()
+
+
+def test_router_rejects_bad_frames_typed():
+    cell = _cell()
+    try:
+        def _exchange(frame):
+            s = socket.create_connection(("127.0.0.1", cell.port),
+                                         timeout=30)
+            s.settimeout(30)
+            try:
+                s.sendall(frame)
+                hdr = b""
+                while len(hdr) < HEADER_LEN:
+                    chunk = s.recv(HEADER_LEN - len(hdr))
+                    assert chunk
+                    hdr += chunk
+                ftype, ln = decode_header(hdr)
+                payload = b""
+                while len(payload) < ln:
+                    payload += s.recv(ln - len(payload))
+                assert s.recv(64) == b""  # protocol errors close
+                return ftype, payload
+            finally:
+                s.close()
+
+        ftype, payload = _exchange(encode_frame(0x7F, b"junk"))
+        assert ftype == FRAME_ERR
+        _, code, _ = decode_error_payload(payload)
+        assert code == ERR_PROTO_BAD_TYPE
+
+        ftype, payload = _exchange(encode_frame(FRAME_REQ, b"\x00\x01"))
+        assert ftype == FRAME_ERR
+        _, code, _ = decode_error_payload(payload)
+        assert code == ERR_PROTO_MALFORMED
+    finally:
+        cell.close()
+
+
+def test_router_partition_fault_recovered_by_retry():
+    cell = _cell()
+    try:
+        with inject(
+            FaultPlan([FaultSpec("cell.route", "raise", count=1)]), seed=3
+        ) as inj:
+            cli = IngressClient(port=cell.port, timeout_s=60)
+            try:
+                res = verify_with_retry(
+                    cli, _item("cell-part"), tenant="t", retries=4,
+                    backoff_s=0.01, max_backoff_s=0.05,
+                )
+            finally:
+                cli.close()
+        assert res.ok
+        assert inj.fired.get(("cell.route", "raise")) == 1
+    finally:
+        cell.close()
+
+
+# -- client: multi-endpoint failover -----------------------------------
+
+
+class _CountingClient(IngressClient):
+    def __init__(self, *a, **kw):
+        super().__init__(*a, **kw)
+        self.calls = 0
+
+    def verify(self, item, tenant="default"):
+        self.calls += 1
+        return super().verify(item, tenant)
+
+
+def test_client_rotation_order_wraps():
+    eps = [("h0", 10), ("h1", 11), ("h2", 12)]
+    cli = IngressClient(endpoints=eps)
+    seen = [(cli.host, cli.port)]
+    for _ in range(3):
+        cli.rotate()
+        seen.append((cli.host, cli.port))
+    assert seen == [eps[0], eps[1], eps[2], eps[0]]  # in order, wraps
+    solo = IngressClient(port=9)
+    solo.rotate()  # single endpoint: a no-op
+    assert (solo.host, solo.port) == ("127.0.0.1", 9)
+    with pytest.raises(ValueError):
+        IngressClient(endpoints=[])
+    with pytest.raises(ValueError):
+        IngressClient(endpoints=[("h", 0)])
+
+
+def test_client_rotates_to_live_endpoint_on_connect_error():
+    with _host_vs() as vs:
+        ing = IngressServer(vs).start()
+        try:
+            cli = _CountingClient(endpoints=[
+                ("127.0.0.1", _dead_port()),  # first endpoint is down
+                ("127.0.0.1", ing.port),
+            ])
+            try:
+                res = verify_with_retry(
+                    cli, _item("cli-rot"), retries=3,
+                    backoff_s=0.01, max_backoff_s=0.05,
+                )
+            finally:
+                cli.close()
+            assert res.ok
+            assert cli.calls == 2  # one failure, one win on the rotation
+            assert (cli.host, cli.port) == ("127.0.0.1", ing.port)
+        finally:
+            ing.close(drain=True)
+
+
+def test_client_never_retries_protocol_errors():
+    with _host_vs() as vs:
+        ing = IngressServer(vs, max_frame=64).start()  # everything oversized
+        try:
+            cli = _CountingClient(endpoints=[
+                ("127.0.0.1", ing.port), ("127.0.0.1", ing.port),
+            ])
+            try:
+                with pytest.raises(IngressProtocolError):
+                    verify_with_retry(
+                        cli, _item("cli-proto"), retries=5,
+                        backoff_s=0.01, max_backoff_s=0.05,
+                    )
+                # Deterministic reject: one attempt, no budget burned.
+                assert cli.calls == 1
+            finally:
+                cli.close()
+        finally:
+            ing.close(drain=True)
+
+
+def test_client_gives_up_after_retry_budget():
+    cli = _CountingClient(endpoints=[
+        ("127.0.0.1", _dead_port()), ("127.0.0.1", _dead_port()),
+    ])
+    try:
+        with pytest.raises(ConnectionError):
+            verify_with_retry(
+                cli, _item("cli-dead"), retries=2,
+                backoff_s=0.01, max_backoff_s=0.02,
+            )
+        assert cli.calls == 3  # initial attempt + the bounded budget
+    finally:
+        cli.close()
+
+
+# -- the wired cell ----------------------------------------------------
+
+
+def test_cell_handoff_preserves_warmth_and_tombstones():
+    """Kill a replica with a warmed store: its shards stream to the
+    survivor (warm hits, no re-dispatch of clean entries) and an
+    audit-convicted key (ADD…DEL) stays convicted after the move."""
+    cell = _cell(evict_after=2)
+    try:
+        tenant = "handoff-tenant"
+        home = cell.router._home.lookup(tenant)
+        survivor = next(n for n in cell.replicas if n != home)
+        with IngressClient(port=cell.port, timeout_s=60) as cli:
+            assert cli.verify(_item("cell-warm"), tenant=tenant).ok
+        poison = b"\x5a" * 32
+        store = cell.replicas[home].store
+        store.add_key(poison)
+        store.discard_key(poison)  # durable tombstone in the home's log
+        e_home = cell.replicas[home].control({"cmd": "stats"})["entries"]
+        assert e_home >= 1
+
+        cell.replicas[home].kill()
+        cell.tick()  # dead -> evict -> handoff to the survivor
+        assert home not in cell.healthy_names()
+        peek = cell.replicas[survivor].control(
+            {"cmd": "peek", "key": poison.hex()})
+        assert peek["ok"] and not peek["present"]
+
+        s0 = cell.replicas[survivor].control({"cmd": "stats"})
+        with IngressClient(port=cell.port, timeout_s=60) as cli:
+            assert cli.verify(_item("cell-warm"), tenant=tenant).ok
+        s1 = cell.replicas[survivor].control({"cmd": "stats"})
+        probes = s1["probes"] - s0["probes"]
+        hits = s1["hits"] - s0["hits"]
+        # Clean handed-off entries answer warm: zero re-dispatch.
+        assert probes >= 1 and hits == probes
+    finally:
+        cell.close()
+
+
+@pytest.mark.slow
+def test_cell_subprocess_kill9_failover_and_repromote():
+    """End to end on real processes: kill -9 one replica, the cell
+    keeps verifying through the survivor, and the victim re-promotes
+    through a passing known-answer probe on a fresh port."""
+    cell = ServingCell(
+        n_replicas=2, stub=False,
+        server_kw=dict(max_batch=8, flush_s=0.005),
+        evict_after=2, backoff_s=0.05, max_backoff_s=0.2,
+    ).start()
+    try:
+        tenant = "e2e-tenant"
+        victim = cell.router._home.lookup(tenant)
+        good, bad = _item("cell-e2e"), _item("cell-e2e-bad", corrupt=True)
+        cli = IngressClient(port=cell.port, timeout_s=120)
+        try:
+            assert cli.verify(good, tenant=tenant).ok
+            cell.replicas[victim].kill()  # SIGKILL
+            cell.tick()
+            assert victim not in cell.healthy_names()
+            rng = __import__("random").Random(0)
+            assert verify_with_retry(
+                cli, good, tenant=tenant, retries=8,
+                backoff_s=0.02, max_backoff_s=0.2, rng=rng,
+            ).ok
+            assert not verify_with_retry(
+                cli, bad, tenant=tenant, retries=8,
+                backoff_s=0.02, max_backoff_s=0.2, rng=rng,
+            ).ok
+            deadline = time.monotonic() + 90
+            while (victim not in cell.healthy_names()
+                   and time.monotonic() < deadline):
+                time.sleep(0.05)
+                cell.tick()
+            assert victim in cell.healthy_names()
+            assert cli.verify(good, tenant=tenant).ok
+        finally:
+            cli.close()
+    finally:
+        cell.close()
